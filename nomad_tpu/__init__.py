@@ -1,0 +1,11 @@
+"""nomad_tpu — a TPU-native workload-orchestration framework.
+
+A brand-new framework with the capabilities of HashiCorp Nomad (studied at
+/root/reference, surveyed in SURVEY.md), re-designed TPU-first: the host runs
+a conventional control plane (state store, eval broker, plan applier, node
+agents), while the scheduling math — constraint feasibility, bin-pack fit and
+scoring, spread/affinity, preemption search, and plan-commit re-verification —
+runs as batched JAX/XLA kernels over a device-resident cluster matrix.
+"""
+
+__version__ = "0.1.0"

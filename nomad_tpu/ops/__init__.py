@@ -1,0 +1,21 @@
+"""Vectorized scheduling math (JAX kernels + request encoding)."""
+
+from .encode import (  # noqa: F401
+    CompiledTaskGroup,
+    EscapedConstraint,
+    RequestEncoder,
+    SchedRequest,
+    MAX_CONSTRAINTS,
+    MAX_SPREADS,
+    MAX_SPREAD_VALUES,
+)
+from .kernels import (  # noqa: F401
+    NEG_INF,
+    PlacementResult,
+    ScoreResult,
+    feasibility_mask,
+    fit_and_binpack,
+    place_task_group,
+    score_nodes,
+    verify_plan_fit,
+)

@@ -1,0 +1,503 @@
+"""Vectorized scheduling kernels — the hot path, in JAX.
+
+Each kernel is a pure function over the device-resident node matrix
+(``state.matrix.DeviceArrays``) and a compiled request
+(``ops.encode.SchedRequest``). Where the reference pulls nodes one at a time
+through a 14-iterator chain (scheduler/stack.go:324-417) and bounds work by
+sampling log₂(n) candidates (stack.go:78-91), these kernels score **all**
+nodes in one fused XLA program; placement of ``count`` allocs is a
+``lax.scan`` that scatters proposed usage between steps (the reference's
+in-plan "proposed allocs" cache, rank.go:41-52).
+
+Score semantics mirror the reference exactly (see tests/test_kernels.py
+golden tests against the scalar oracle in structs.funcs):
+  binpack     = ScoreFitBinPack/18           (funcs.go:186, rank.go:513)
+  anti-aff    = -(collisions+1)/desired      (rank.go:601-607, only if >0)
+  penalty     = -1 on penalized nodes        (rank.go:646, only if penalized)
+  affinity    = Σ weight·match / Σ|weight|   (rank.go:704-728, only if ≠0)
+  spread      = per-stanza boosts            (spread.go:110-178, only if ≠0)
+  preemption  = logistic(netPriority)        (rank.go:773-844, only if used)
+  final       = mean of appended components  (rank.go:737-771)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..state.matrix import PRIORITY_BUCKETS
+from .encode import (
+    OP_EQ,
+    OP_GT,
+    OP_GTE,
+    OP_IS_NOT_SET,
+    OP_IS_SET,
+    OP_LT,
+    OP_LTE,
+    OP_NEQ,
+    OP_VER_EQ,
+    OP_VER_GT,
+    OP_VER_GTE,
+    OP_VER_LT,
+    OP_VER_LTE,
+    SchedRequest,
+)
+
+# Plain float (not a jnp scalar): materializing a device array at import
+# time would force backend initialization on `import nomad_tpu`.
+NEG_INF = -1e30
+
+# Preemption score constants (reference: rank.go preemptionScore).
+PREEMPTION_RATE = 0.0048
+PREEMPTION_ORIGIN = 2048.0
+
+
+# ---------------------------------------------------------------------------
+# Feasibility
+# ---------------------------------------------------------------------------
+
+
+def _check_predicate(attr_hash, attr_num, attr_ver, slot, op, want_hash, want_num):
+    """Evaluate one predicate for every node. Shapes: attr_* (N, A); returns
+    (N,) bool. Inactive predicates (slot < 0) return True.
+
+    Missing-attribute semantics follow checkConstraint (feasible.go:793-858):
+    ``=`` and ordered comparisons require the attribute to be present; ``!=``
+    passes when it is absent. Version ops read the version-packed column.
+    """
+    safe_slot = jnp.maximum(slot, 0)
+    h = attr_hash[:, safe_slot]  # (N,)
+    v = attr_num[:, safe_slot]  # (N,)
+    ver = attr_ver[:, safe_slot]  # (N,)
+    present = h != 0
+    num_ok = present & ~jnp.isnan(v) & ~jnp.isnan(want_num)
+    ver_ok = present & ~jnp.isnan(ver) & ~jnp.isnan(want_num)
+
+    eq = present & (h == want_hash)
+    res = jnp.full(h.shape, True)
+    res = jnp.where(op == OP_EQ, eq, res)
+    res = jnp.where(op == OP_NEQ, ~eq, res)
+    res = jnp.where(op == OP_LT, num_ok & (v < want_num), res)
+    res = jnp.where(op == OP_LTE, num_ok & (v <= want_num), res)
+    res = jnp.where(op == OP_GT, num_ok & (v > want_num), res)
+    res = jnp.where(op == OP_GTE, num_ok & (v >= want_num), res)
+    res = jnp.where(op == OP_VER_EQ, ver_ok & (ver == want_num), res)
+    res = jnp.where(op == OP_VER_LT, ver_ok & (ver < want_num), res)
+    res = jnp.where(op == OP_VER_LTE, ver_ok & (ver <= want_num), res)
+    res = jnp.where(op == OP_VER_GT, ver_ok & (ver > want_num), res)
+    res = jnp.where(op == OP_VER_GTE, ver_ok & (ver >= want_num), res)
+    res = jnp.where(op == OP_IS_SET, present, res)
+    res = jnp.where(op == OP_IS_NOT_SET, ~present, res)
+    return jnp.where(slot < 0, True, res)
+
+
+def constraint_mask(arrays, req: SchedRequest) -> jnp.ndarray:
+    """(N,) bool — all hard constraints pass (ConstraintChecker equivalent)."""
+    check = jax.vmap(
+        lambda s, o, h, n: _check_predicate(
+            arrays.attr_hash, arrays.attr_num, arrays.attr_ver, s, o, h, n
+        )
+    )
+    per_constraint = check(req.c_slot, req.c_op, req.c_hash, req.c_num)  # (C, N)
+    return jnp.all(per_constraint, axis=0)
+
+
+def datacenter_mask(arrays, req: SchedRequest) -> jnp.ndarray:
+    """(N,) bool — node's datacenter is in the job's list (util.go
+    readyNodesInDCs). Attribute slot 0 is node.datacenter by registry order."""
+    dc = arrays.attr_hash[:, 0]  # (N,)
+    member = (dc[:, None] == req.dc_hash[None, :]) & (req.dc_hash[None, :] > 0)
+    skip = req.dc_hash[0] == -1  # escaped: host filters datacenters instead
+    return jnp.any(member, axis=1) | skip
+
+
+def device_mask(arrays, req: SchedRequest) -> jnp.ndarray:
+    """(N,) bool — free device instances cover the ask (DeviceChecker +
+    accounting, feasible.go:1173, structs DeviceAccounter)."""
+    free = arrays.dev_total - arrays.dev_used  # (N, D)
+    ok = (free >= req.dev_ask[None, :]) | (req.dev_ask[None, :] == 0)
+    return jnp.all(ok, axis=1)
+
+
+def feasibility_mask(arrays, req: SchedRequest, class_elig=None, host_mask=None):
+    """(N,) bool — eligible ∧ dc ∧ constraints ∧ devices ∧ escaped checks.
+
+    ``class_elig``: (num_classes,) bool from host-side evaluation of escaped
+    constraints, gathered per node via class_id (the computed-class cache,
+    feasible.go:1029). ``host_mask``: optional (N,) bool for unique-attr
+    escapes.
+    """
+    mask = arrays.eligible
+    mask &= datacenter_mask(arrays, req)
+    mask &= constraint_mask(arrays, req)
+    mask &= device_mask(arrays, req)
+    if class_elig is not None:
+        cid = jnp.maximum(arrays.class_id, 0)
+        mask &= jnp.where(arrays.class_id < 0, False, class_elig[cid])
+    if host_mask is not None:
+        mask &= host_mask
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Scoring
+# ---------------------------------------------------------------------------
+
+
+def fit_and_binpack(arrays, used, req: SchedRequest):
+    """Resource fit + normalized fit score for all nodes.
+
+    Returns (fits (N,) bool, score (N,) f32, exhausted_dim (N,) i32).
+    util = current used + ask; fit requires util ≤ totals in all dims
+    (AllocsFit, funcs.go:97-160); score per scheduler_algorithm
+    (rank.go:166-170, funcs.go:186/213) normalized by 18 (rank.go:513-516).
+    """
+    util = used + req.ask[None, :]  # (N, 3)
+    fits_dim = util <= arrays.totals  # (N, 3)
+    fits = jnp.all(fits_dim, axis=1)
+    # first exhausted dim index for metrics (0=cpu,1=mem,2=disk, -1 = fits)
+    exhausted = jnp.argmax(~fits_dim, axis=1).astype(jnp.int32)
+    exhausted = jnp.where(fits, -1, exhausted)
+
+    denom = jnp.maximum(arrays.totals, 1.0)
+    free = 1.0 - util / denom  # (N, 3)
+    free_cpu, free_mem = free[:, 0], free[:, 1]
+    total = jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)
+    binpack = jnp.clip(20.0 - total, 0.0, 18.0)
+    spread = jnp.clip(total - 2.0, 0.0, 18.0)
+    score = jnp.where(req.algorithm == 1, spread, binpack) / 18.0
+    return fits, score, exhausted
+
+
+def anti_affinity_score(tg_count, req: SchedRequest):
+    """(score (N,), appended (N,)) — JobAntiAffinityIterator (rank.go:560-607).
+
+    ``tg_count`` (N,) i32 = proposed allocs of this job+TG per node."""
+    collisions = tg_count.astype(jnp.float32)
+    score = -(collisions + 1.0) / req.desired_count
+    appended = collisions > 0
+    return jnp.where(appended, score, 0.0), appended
+
+
+def penalty_score(penalty_mask):
+    """NodeReschedulingPenaltyIterator (rank.go:630-646)."""
+    return jnp.where(penalty_mask, -1.0, 0.0), penalty_mask
+
+
+def affinity_score(arrays, req: SchedRequest):
+    """NodeAffinityIterator (rank.go:698-728): Σ weight·match / Σ|weight|,
+    appended only when non-zero."""
+    check = jax.vmap(
+        lambda s, o, h, n: _check_predicate(
+            arrays.attr_hash, arrays.attr_num, arrays.attr_ver, s, o, h, n
+        )
+    )
+    matches = check(req.a_slot, req.a_op, req.a_hash, req.a_num)  # (A, N)
+    active = (req.a_slot >= 0)[:, None]  # (A, 1)
+    matched = matches & active
+    sum_weight = jnp.sum(jnp.abs(req.a_weight) * (req.a_slot >= 0))
+    total = jnp.sum(matched * req.a_weight[:, None], axis=0)  # (N,)
+    norm = total / jnp.maximum(sum_weight, 1e-9)
+    appended = (total != 0.0) & (sum_weight > 0)
+    return jnp.where(appended, norm, 0.0), appended
+
+
+def spread_score(arrays, req: SchedRequest, spread_counts):
+    """SpreadIterator (spread.go:110-257).
+
+    ``spread_counts`` (S, V) f32 — usage count per known attribute value
+    (existing + proposed allocs of this TG), aligned with req.s_value_hash.
+    Returns (score (N,), appended (N,)).
+    """
+
+    def one_stanza(slot, weight, even, value_hash, desired, implicit, counts):
+        active = slot >= 0
+        nvalue = arrays.attr_hash[:, jnp.maximum(slot, 0)]  # (N,)
+        node_has = nvalue != 0
+
+        # match node value against the known-values table
+        vmatch = (nvalue[:, None] == value_hash[None, :]) & (
+            value_hash[None, :] != 0
+        )  # (N, V)
+        found = jnp.any(vmatch, axis=1)
+        vidx = jnp.argmax(vmatch, axis=1)  # (N,)
+        used_count = jnp.where(found, counts[vidx], 0.0) + 1.0  # +1 = this placement
+
+        # ---- targeted mode (spread.go:134-165)
+        has_target = ~jnp.isnan(desired[vidx]) & found
+        desired_v = jnp.where(has_target, desired[jnp.maximum(vidx, 0)], jnp.nan)
+        use_implicit = ~has_target & ~jnp.isnan(implicit)
+        desired_v = jnp.where(use_implicit, implicit, desired_v)
+        no_target = jnp.isnan(desired_v)
+        rel_weight = weight / jnp.maximum(req.s_sum_weights, 1e-9)
+        boost_t = ((desired_v - used_count) / jnp.maximum(desired_v, 1e-9)) * rel_weight
+        targeted = jnp.where(no_target, -1.0, boost_t)
+
+        # ---- even mode (spread.go evenSpreadScoreBoost:178-230)
+        valid = (value_hash != 0) & (counts > 0)
+        any_use = jnp.any(valid)
+        big = jnp.float32(1e30)
+        mn = jnp.min(jnp.where(valid, counts, big))
+        mx = jnp.max(jnp.where(valid, counts, -big))
+        current = jnp.where(found, counts[vidx], 0.0)
+        delta_boost = jnp.where(mn == 0, -1.0, (mn - current) / jnp.maximum(mn, 1e-9))
+        even_b = jnp.where(
+            current != mn,
+            delta_boost,
+            jnp.where(
+                mn == mx,
+                -1.0,
+                jnp.where(mn == 0, 1.0, (mx - mn) / jnp.maximum(mn, 1e-9)),
+            ),
+        )
+        even_b = jnp.where(any_use, even_b, 0.0)
+        even_b = jnp.where(node_has, even_b, -1.0)  # attr unset → max penalty
+
+        score = jnp.where(even, even_b, targeted)
+        return jnp.where(active, score, 0.0)
+
+    per_stanza = jax.vmap(one_stanza)(
+        req.s_slot,
+        req.s_weight,
+        req.s_even,
+        req.s_value_hash,
+        req.s_desired,
+        req.s_implicit,
+        spread_counts,
+    )  # (S, N)
+    total = jnp.sum(per_stanza, axis=0)
+    has_spread = jnp.any(req.s_slot >= 0)
+    appended = (total != 0.0) & has_spread
+    return jnp.where(appended, total, 0.0), appended
+
+
+def preemption_state(arrays, req: SchedRequest):
+    """Vectorized preemption candidate math.
+
+    The reference walks per-node alloc lists greedily
+    (preemption.go:198-557). Here ``prio_used`` (N, P, 3) holds usage per
+    priority bucket; everything strictly below ``preempt_bucket`` is
+    evictable, so freeable = Σ lower buckets — a prefix-sum replacing the
+    candidate walk. netPriority is approximated from bucket midpoints.
+
+    Returns (extra_free (N,3), preempt_score (N,), usable (N,) bool).
+    """
+    buckets = jnp.arange(PRIORITY_BUCKETS)
+    evictable = (buckets < req.preempt_bucket)[None, :, None]  # (1, P, 1)
+    freeable = jnp.sum(jnp.where(evictable, arrays.prio_used, 0.0), axis=1)  # (N, 3)
+
+    # Approximate net priority from bucket midpoints (rank.go netPriority).
+    mid = (buckets.astype(jnp.float32) + 0.5) * (101.0 / PRIORITY_BUCKETS)
+    present = jnp.any(arrays.prio_used > 0, axis=2) & evictable[:, :, 0]  # (N, P)
+    max_prio = jnp.max(jnp.where(present, mid[None, :], 0.0), axis=1)  # (N,)
+    sum_prio = jnp.sum(jnp.where(present, mid[None, :], 0.0), axis=1)
+    net = jnp.where(max_prio > 0, max_prio + sum_prio / jnp.maximum(max_prio, 1e-9), 0.0)
+    score = 1.0 / (1.0 + jnp.exp(PREEMPTION_RATE * (net - PREEMPTION_ORIGIN)))
+
+    usable = (req.preempt_bucket >= 0) & jnp.any(freeable > 0, axis=1)
+    return freeable, score, usable
+
+
+class ScoreResult(NamedTuple):
+    final: jnp.ndarray  # (N,) f32, NEG_INF where infeasible
+    feasible: jnp.ndarray  # (N,) bool (constraints, pre-resource)
+    fits: jnp.ndarray  # (N,) bool (resources, incl. preemption assist)
+    needs_preempt: jnp.ndarray  # (N,) bool
+    binpack: jnp.ndarray  # (N,) f32
+    exhausted_dim: jnp.ndarray  # (N,) i32
+
+
+def score_nodes(
+    arrays,
+    used,
+    tg_count,
+    spread_counts,
+    penalty_mask,
+    req: SchedRequest,
+    class_elig,
+    host_mask,
+) -> ScoreResult:
+    """The full ranking pipeline as one fused program (GenericStack.Select,
+    stack.go:117-179, minus the sampling the TPU design makes unnecessary)."""
+    feas = feasibility_mask(arrays, req, class_elig, host_mask)
+    fits, binpack, exhausted = fit_and_binpack(arrays, used, req)
+
+    # Preemption assist: nodes that don't fit but could after evicting
+    # lower-priority work (generic_sched.go:773-792 retry pass).
+    extra_free, pre_score, pre_usable = preemption_state(arrays, req)
+    util = used + req.ask[None, :]
+    fits_with_preempt = jnp.all(util - extra_free <= arrays.totals, axis=1)
+    needs_preempt = ~fits & fits_with_preempt & pre_usable
+    fits_all = fits | needs_preempt
+
+    aa_score, aa_app = anti_affinity_score(tg_count, req)
+    pen_score, pen_app = penalty_score(penalty_mask)
+    aff_score, aff_app = affinity_score(arrays, req)
+    spr_score, spr_app = spread_score(arrays, req, spread_counts)
+    pre_component = jnp.where(needs_preempt, pre_score, 0.0)
+
+    total = binpack + aa_score + pen_score + aff_score + spr_score + pre_component
+    count = (
+        1.0
+        + aa_app.astype(jnp.float32)
+        + pen_app.astype(jnp.float32)
+        + aff_app.astype(jnp.float32)
+        + spr_app.astype(jnp.float32)
+        + needs_preempt.astype(jnp.float32)
+    )
+    final = total / count
+    final = jnp.where(feas & fits_all, final, NEG_INF)
+    return ScoreResult(
+        final=final,
+        feasible=feas,
+        fits=fits_all,
+        needs_preempt=needs_preempt,
+        binpack=binpack,
+        exhausted_dim=exhausted,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Placement scan
+# ---------------------------------------------------------------------------
+
+
+class PlacementResult(NamedTuple):
+    rows: jnp.ndarray  # (P,) i32 chosen node row, -1 = failed
+    scores: jnp.ndarray  # (P,) f32 final score of chosen node
+    binpack: jnp.ndarray  # (P,) f32 binpack component
+    preempted: jnp.ndarray  # (P,) bool placement requires preemption
+    nodes_evaluated: jnp.ndarray  # (P,) i32
+    nodes_filtered: jnp.ndarray  # (P,) i32 failed constraints
+    nodes_exhausted: jnp.ndarray  # (P,) i32 feasible but resource-exhausted
+    used_after: jnp.ndarray  # (N, 3) proposed usage after placements
+    tg_count_after: jnp.ndarray  # (N,)
+
+
+def _update_spread_counts(spread_counts, req: SchedRequest, arrays, row):
+    """After placing on ``row``, bump the count of that node's attribute value
+    per stanza (propertyset.go usage tracking). Claims an empty value slot on
+    first sight of a new value."""
+
+    def one(slot, value_hash, counts):
+        nvalue = arrays.attr_hash[row, jnp.maximum(slot, 0)]
+        match = (value_hash == nvalue) & (nvalue != 0)
+        have = jnp.any(match)
+        free_slot = jnp.argmax(value_hash == 0)
+        idx = jnp.where(have, jnp.argmax(match), free_slot)
+        can = (slot >= 0) & (nvalue != 0) & (have | (value_hash[free_slot] == 0))
+        new_hash = jnp.where(
+            can & ~have, value_hash.at[idx].set(nvalue), value_hash
+        )
+        new_counts = jnp.where(can, counts.at[idx].add(1.0), counts)
+        return new_hash, new_counts
+
+    new_hashes, new_counts = jax.vmap(one)(
+        req.s_slot, req.s_value_hash, spread_counts
+    )
+    return new_hashes, new_counts
+
+
+@functools.partial(jax.jit, static_argnames=("n_placements",))
+def place_task_group(
+    arrays,
+    req: SchedRequest,
+    used0,
+    tg_count,
+    spread_counts,
+    penalty_mask,
+    class_elig,
+    host_mask,
+    n_placements: int,
+) -> PlacementResult:
+    """Place ``n_placements`` allocs of one TG — the kernel behind
+    computePlacements (generic_sched.go:472).
+
+    A lax.scan over placements: each step scores all nodes, takes the argmax
+    (replacing Limit/MaxScore sampling, stack.go:78-91), and scatters the
+    proposed usage so subsequent placements see it (ProposedAllocs semantics,
+    rank.go:41-52).
+
+    ``used0`` (N, 3) is the proposed base usage — the authoritative matrix
+    usage already adjusted by the reconciler's planned stops/evictions
+    (the reference's ProposedAllocs = existing − plan.NodeUpdate + in-plan,
+    scheduler/context.go ProposedAllocs).
+    """
+
+    def step(carry, _):
+        used, tg_cnt, s_hash, s_counts = carry
+        req_step = req._replace(s_value_hash=s_hash)
+        res = score_nodes(
+            arrays, used, tg_cnt, s_counts, penalty_mask, req_step,
+            class_elig, host_mask,
+        )
+        row = jnp.argmax(res.final).astype(jnp.int32)
+        ok = res.final[row] > NEG_INF / 2
+        row = jnp.where(ok, row, -1)
+
+        n_eval = jnp.sum(res.feasible).astype(jnp.int32)
+        n_filtered = jnp.sum(~res.feasible & arrays.eligible).astype(jnp.int32)
+        n_exhausted = jnp.sum(res.feasible & ~res.fits).astype(jnp.int32)
+
+        safe_row = jnp.maximum(row, 0)
+        used2 = jnp.where(ok, used.at[safe_row].add(req.ask), used)
+        tg2 = jnp.where(ok, tg_cnt.at[safe_row].add(1), tg_cnt)
+        new_hash, new_counts = _update_spread_counts(s_counts, req_step, arrays, safe_row)
+        s_hash2 = jnp.where(ok, new_hash, s_hash)
+        s_counts2 = jnp.where(ok, new_counts, s_counts)
+
+        out = (
+            row,
+            jnp.where(ok, res.final[safe_row], 0.0),
+            jnp.where(ok, res.binpack[safe_row], 0.0),
+            ok & res.needs_preempt[safe_row],
+            n_eval,
+            n_filtered,
+            n_exhausted,
+        )
+        return (used2, tg2, s_hash2, s_counts2), out
+
+    init = (used0, tg_count, req.s_value_hash, spread_counts)
+    (used_after, tg_after, _, _), outs = lax.scan(
+        step, init, None, length=n_placements
+    )
+    rows, scores, binpack, preempted, n_eval, n_filt, n_exh = outs
+    return PlacementResult(
+        rows=rows,
+        scores=scores,
+        binpack=binpack,
+        preempted=preempted,
+        nodes_evaluated=n_eval,
+        nodes_filtered=n_filt,
+        nodes_exhausted=n_exh,
+        used_after=used_after,
+        tg_count_after=tg_after,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Plan-apply verification (AllocsFit re-check at commit time)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def verify_plan_fit(arrays, rows, deltas, eligible_required):
+    """Vectorized optimistic-concurrency check for the plan applier.
+
+    The reference fans per-node AllocsFit checks out to an EvaluatePool of
+    goroutines (plan_apply.go:439-682, plan_apply_pool.go:18). Here the whole
+    plan verifies in one kernel against the authoritative matrix: for each
+    plan row i, (used + delta ≤ totals) ∧ node still schedulable.
+
+    rows: (K,) i32 node rows (-1 padded); deltas: (K, 3) f32 net usage the
+    plan adds to that node; returns (K,) bool per-node verdicts.
+    """
+    safe = jnp.maximum(rows, 0)
+    used = arrays.used[safe] + deltas  # (K, 3)
+    fits = jnp.all(used <= arrays.totals[safe], axis=1)
+    ok = fits & (~eligible_required | arrays.eligible[safe])
+    return jnp.where(rows < 0, True, ok)

@@ -1,0 +1,465 @@
+"""Device-resident cluster matrix — the core TPU-native data structure.
+
+The reference walks Go node objects per evaluation (BinPackIterator,
+scheduler/rank.go:149-531) and bounds work via node sampling
+(scheduler/stack.go:78-91) and a computed-class feasibility cache
+(scheduler/feasible.go:1029). This framework inverts that design: the whole
+cluster is encoded once into dense arrays resident in TPU HBM, and every
+evaluation scores *all* nodes in one vectorized pass.
+
+Encoding:
+  totals    (N, 3) f32  — comparable resources (total − reserved): cpu/mem/disk
+  used      (N, 3) f32  — sum over non-terminal allocs per node
+  eligible  (N,)   bool — ready & eligible & not draining
+  attr_hash (N, A) i32  — stable nonzero hash per registered attribute slot
+                           (0 = attribute unset)
+  attr_num  (N, A) f32  — numeric value of the attribute (NaN if non-numeric)
+  attr_ver  (N, A) f32  — version packing major*1e6+minor*1e3+patch (NaN none)
+  class_id  (N,)   i32  — computed-class id (reference: node_class.go:28-37);
+                           host-side fallback constraint checks are evaluated
+                           once per class and gathered per node
+  dev_total (N, D) i32  — device instances per registered device-type slot
+  dev_used  (N, D) i32
+  prio_used (N, P, 3) f32 — per-priority-bucket resource usage, enabling the
+                           vectorized preemption search (a prefix-sum over the
+                           priority axis replaces the reference's greedy
+                           candidate walk, scheduler/preemption.go:198-557)
+  tg_count  (N,)   i32  — allocs of the *current* job+TG per node (scattered
+                           before each eval batch; drives JobAntiAffinity)
+
+Host-side, a mirror lives in numpy; mutations mark dirty rows and `sync()`
+scatters only those rows to the device (SURVEY.md §7 hard-part a: bound
+host↔device transfer per plan).
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..structs.types import Allocation, Node
+
+# Fixed encoding widths. Attribute slots beyond ATTR_SLOTS fall back to
+# host-side per-class evaluation (the reference's own escape hatch).
+ATTR_SLOTS = 32
+DEVICE_SLOTS = 8
+PRIORITY_BUCKETS = 16  # job priorities 1..100 bucketed by 100/PRIORITY_BUCKETS
+RESOURCE_DIMS = 3  # cpu, mem, disk
+
+
+def stable_hash(value: str) -> int:
+    """Stable nonzero 31-bit hash of a string attribute value."""
+    h = zlib.crc32(value.encode("utf-8")) & 0x7FFFFFFF
+    return h if h != 0 else 1
+
+
+def numeric_value(value: str) -> float:
+    """Plain numeric interpretation of an attribute value, NaN otherwise.
+    Used for ordered comparisons (``<``, ``>=``, …)."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return math.nan
+
+
+def version_value(value: str) -> float:
+    """Version interpretation: 1-3 dot-separated integer components packed as
+    major*1e6 + minor*1e3 + patch (missing components are 0); NaN otherwise.
+
+    Kept separate from :func:`numeric_value` because strings like ``"2.0"``
+    are both a valid decimal and a valid version — ``version``-operand
+    comparisons read this column, ordered numeric comparisons read the plain
+    one, and both sides of a comparison always use the same encoding.
+    """
+    if not isinstance(value, str):
+        return math.nan
+    v = value.strip()
+    if v.startswith("v"):
+        v = v[1:]
+    parts = v.split(".")
+    if not 1 <= len(parts) <= 3:
+        return math.nan
+    try:
+        nums = [int(p) for p in parts]
+    except ValueError:
+        return math.nan
+    while len(nums) < 3:
+        nums.append(0)
+    major, minor, patch = nums
+    if minor >= 1000 or patch >= 1000 or major < 0 or minor < 0 or patch < 0:
+        return math.nan
+    return major * 1e6 + minor * 1e3 + patch
+
+
+def priority_bucket(priority: int) -> int:
+    """Map a job priority (1..100) to a preemption bucket."""
+    p = min(max(int(priority), 0), 100)
+    return min(p * PRIORITY_BUCKETS // 101, PRIORITY_BUCKETS - 1)
+
+
+# Attributes excluded from the computed class because they are node-unique
+# (reference: nomad/structs/node_class.go EscapedConstraints / unique prefix).
+UNIQUE_PREFIX = "unique."
+
+
+class AttributeRegistry:
+    """Maps attribute names to matrix column slots.
+
+    Well-known scheduling attributes are pre-registered so every cluster gets
+    identical encodings; fingerprinted attributes claim remaining slots on
+    first sight. Constraints on unregistered attributes escape to the
+    host-side per-class path.
+    """
+
+    WELL_KNOWN = [
+        "node.datacenter",
+        "node.class",
+        "node.unique.name",
+        "node.unique.id",
+        "kernel.name",
+        "cpu.arch",
+        "cpu.numcores",
+        "os.name",
+        "os.version",
+        "driver.mock",
+        "driver.exec",
+        "driver.raw_exec",
+        "driver.docker",
+        "driver.java",
+        "driver.qemu",
+        "platform.tpu.type",
+    ]
+
+    def __init__(self, slots: int = ATTR_SLOTS):
+        self.slots = slots
+        self.slot_of: Dict[str, int] = {}
+        for name in self.WELL_KNOWN:
+            if len(self.slot_of) < slots:
+                self.slot_of[name] = len(self.slot_of)
+
+    def lookup(self, name: str) -> Optional[int]:
+        return self.slot_of.get(name)
+
+    def register(self, name: str) -> Optional[int]:
+        slot = self.slot_of.get(name)
+        if slot is not None:
+            return slot
+        if len(self.slot_of) >= self.slots:
+            return None  # escaped — host fallback
+        slot = len(self.slot_of)
+        self.slot_of[name] = slot
+        return slot
+
+
+class DeviceRegistry:
+    """Maps device-type names (e.g. ``nvidia/gpu`` or ``gpu``) to slots."""
+
+    def __init__(self, slots: int = DEVICE_SLOTS):
+        self.slots = slots
+        self.slot_of: Dict[str, int] = {}
+
+    def lookup(self, name: str) -> Optional[int]:
+        return self.slot_of.get(name)
+
+    def register(self, name: str) -> Optional[int]:
+        slot = self.slot_of.get(name)
+        if slot is not None:
+            return slot
+        if len(self.slot_of) >= self.slots:
+            return None
+        slot = len(self.slot_of)
+        self.slot_of[name] = slot
+        return slot
+
+
+def node_attributes(node: Node) -> Dict[str, str]:
+    """Flatten a node into the attribute namespace used by constraints
+    (reference: scheduler/feasible.go resolveTarget :748-790)."""
+    attrs: Dict[str, str] = {}
+    attrs["node.datacenter"] = node.datacenter
+    attrs["node.class"] = node.node_class
+    attrs["node.unique.name"] = node.name
+    attrs["node.unique.id"] = node.id
+    for k, v in node.attributes.items():
+        attrs[k] = v
+    for k, v in node.meta.items():
+        attrs[f"meta.{k}"] = v
+        attrs[f"node.meta.{k}"] = v
+    for name, info in node.drivers.items():
+        attrs[f"driver.{name}"] = "1" if (info.detected and info.healthy) else ""
+    return attrs
+
+
+def computed_class_key(attrs: Dict[str, str], node: Node) -> str:
+    """Class key over non-unique attributes (reference: node_class.go:28-37)."""
+    items = sorted(
+        (k, v)
+        for k, v in attrs.items()
+        if UNIQUE_PREFIX not in k and not k.startswith("node.unique")
+    )
+    items.append(("node.class", node.node_class))
+    return str(zlib.crc32(repr(items).encode()))
+
+
+class DeviceArrays(NamedTuple):
+    """The on-device snapshot consumed by kernels (all jax arrays)."""
+
+    totals: "jax.Array"  # (N, 3) f32
+    used: "jax.Array"  # (N, 3) f32
+    eligible: "jax.Array"  # (N,) bool
+    attr_hash: "jax.Array"  # (N, A) i32
+    attr_num: "jax.Array"  # (N, A) f32
+    attr_ver: "jax.Array"  # (N, A) f32 — version packing (see version_value)
+    class_id: "jax.Array"  # (N,) i32
+    dev_total: "jax.Array"  # (N, D) i32
+    dev_used: "jax.Array"  # (N, D) i32
+    prio_used: "jax.Array"  # (N, P, 3) f32
+
+
+class NodeMatrix:
+    """Host mirror + device copy of the cluster matrix.
+
+    Row lifecycle: nodes claim rows on upsert; removed nodes free their row
+    (marked ineligible until reused). Capacity grows by doubling; growth
+    invalidates the device copy entirely (rare).
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = max(16, capacity)
+        self.attrs = AttributeRegistry()
+        self.devices = DeviceRegistry()
+        self.row_of: Dict[str, int] = {}  # node_id -> row
+        self.node_of: Dict[int, str] = {}  # row -> node_id
+        self._free: List[int] = []
+        self._next_row = 0
+        # class bookkeeping
+        self.class_ids: Dict[str, int] = {}  # class key -> id
+        self.class_repr: Dict[int, str] = {}  # class id -> representative node
+        self._alloc = self._allocate_arrays(self.capacity)
+        self._dirty: set = set()
+        self._device: Optional[DeviceArrays] = None
+        self._device_valid = False
+
+    # -- host arrays --------------------------------------------------------
+
+    def _allocate_arrays(self, cap: int) -> Dict[str, np.ndarray]:
+        return {
+            "totals": np.zeros((cap, RESOURCE_DIMS), np.float32),
+            "used": np.zeros((cap, RESOURCE_DIMS), np.float32),
+            "eligible": np.zeros((cap,), bool),
+            "attr_hash": np.zeros((cap, self.attrs.slots), np.int32),
+            "attr_num": np.full((cap, self.attrs.slots), np.nan, np.float32),
+            "attr_ver": np.full((cap, self.attrs.slots), np.nan, np.float32),
+            "class_id": np.full((cap,), -1, np.int32),
+            "dev_total": np.zeros((cap, self.devices.slots), np.int32),
+            "dev_used": np.zeros((cap, self.devices.slots), np.int32),
+            "prio_used": np.zeros(
+                (cap, PRIORITY_BUCKETS, RESOURCE_DIMS), np.float32
+            ),
+        }
+
+    def _grow(self, min_cap: int) -> None:
+        new_cap = self.capacity
+        while new_cap < min_cap:
+            new_cap *= 2
+        new = self._allocate_arrays(new_cap)
+        for k, arr in self._alloc.items():
+            new[k][: self.capacity] = arr
+        self._alloc = new
+        self.capacity = new_cap
+        self._device_valid = False
+
+    @property
+    def n_rows(self) -> int:
+        return self._next_row
+
+    def _claim_row(self, node_id: str) -> int:
+        row = self.row_of.get(node_id)
+        if row is not None:
+            return row
+        if self._free:
+            row = self._free.pop()
+        else:
+            if self._next_row >= self.capacity:
+                self._grow(self._next_row + 1)
+            row = self._next_row
+            self._next_row += 1
+        self.row_of[node_id] = row
+        self.node_of[row] = node_id
+        return row
+
+    # -- mutations ----------------------------------------------------------
+
+    def upsert_node(self, node: Node) -> int:
+        """Insert or refresh a node's static columns (totals, attrs, class).
+
+        Usage columns are owned by the alloc-delta path.
+        """
+        row = self._claim_row(node.id)
+        a = self._alloc
+        avail = node.comparable_resources()
+        a["totals"][row] = (avail.cpu, avail.memory_mb, avail.disk_mb)
+        a["eligible"][row] = node.ready()
+
+        attrs = node_attributes(node)
+        hash_row = np.zeros((self.attrs.slots,), np.int32)
+        num_row = np.full((self.attrs.slots,), np.nan, np.float32)
+        ver_row = np.full((self.attrs.slots,), np.nan, np.float32)
+        for name, value in attrs.items():
+            if value is None or value == "":
+                continue
+            slot = self.attrs.register(name)
+            if slot is None:
+                continue
+            hash_row[slot] = stable_hash(str(value))
+            num_row[slot] = numeric_value(str(value))
+            ver_row[slot] = version_value(str(value))
+        a["attr_hash"][row] = hash_row
+        a["attr_num"][row] = num_row
+        a["attr_ver"][row] = ver_row
+
+        key = computed_class_key(attrs, node)
+        cid = self.class_ids.get(key)
+        if cid is None:
+            cid = len(self.class_ids)
+            self.class_ids[key] = cid
+            self.class_repr[cid] = node.id
+        a["class_id"][row] = cid
+
+        dev_row = np.zeros((self.devices.slots,), np.int32)
+        for name, instances in node.resources.devices.items():
+            slot = self.devices.register(name)
+            if slot is not None:
+                dev_row[slot] = len(instances)
+        a["dev_total"][row] = dev_row
+
+        self._dirty.add(row)
+        return row
+
+    def set_eligibility(self, node_id: str, eligible: bool) -> None:
+        row = self.row_of.get(node_id)
+        if row is None:
+            return
+        self._alloc["eligible"][row] = eligible
+        self._dirty.add(row)
+
+    def remove_node(self, node_id: str) -> None:
+        row = self.row_of.pop(node_id, None)
+        if row is None:
+            return
+        del self.node_of[row]
+        for k in ("totals", "used", "dev_total", "dev_used"):
+            self._alloc[k][row] = 0
+        self._alloc["eligible"][row] = False
+        self._alloc["class_id"][row] = -1
+        self._alloc["prio_used"][row] = 0
+        self._free.append(row)
+        self._dirty.add(row)
+
+    def _usage_of(self, alloc: Allocation) -> np.ndarray:
+        r = alloc.resources
+        return np.array([r.cpu, r.memory_mb, r.disk_mb], np.float32)
+
+    def add_alloc(self, alloc: Allocation) -> None:
+        """Account a (non-terminal) allocation's usage on its node."""
+        row = self.row_of.get(alloc.node_id)
+        if row is None:
+            return
+        usage = self._usage_of(alloc)
+        self._alloc["used"][row] += usage
+        self._alloc["prio_used"][row, priority_bucket(alloc.job_priority())] += usage
+        for dev in alloc.resources.devices:
+            slot = self.devices.register(dev.name)
+            if slot is not None:
+                self._alloc["dev_used"][row, slot] += dev.count
+        self._dirty.add(row)
+
+    def remove_alloc(self, alloc: Allocation) -> None:
+        row = self.row_of.get(alloc.node_id)
+        if row is None:
+            return
+        usage = self._usage_of(alloc)
+        self._alloc["used"][row] = np.maximum(self._alloc["used"][row] - usage, 0)
+        bucket = priority_bucket(alloc.job_priority())
+        self._alloc["prio_used"][row, bucket] = np.maximum(
+            self._alloc["prio_used"][row, bucket] - usage, 0
+        )
+        for dev in alloc.resources.devices:
+            slot = self.devices.lookup(dev.name)
+            if slot is not None:
+                self._alloc["dev_used"][row, slot] = max(
+                    0, self._alloc["dev_used"][row, slot] - dev.count
+                )
+        self._dirty.add(row)
+
+    # -- device sync --------------------------------------------------------
+
+    def snapshot_host(self) -> Dict[str, np.ndarray]:
+        """Host-side view (no copy) of the active arrays."""
+        return self._alloc
+
+    def sync(self) -> DeviceArrays:
+        """Return the device snapshot, scattering dirty rows if needed.
+
+        Full upload on first use or growth; per-row scatter otherwise
+        (`.at[rows].set`) so steady-state transfer is O(dirty rows).
+        """
+        import jax.numpy as jnp
+
+        if self._device is None or not self._device_valid:
+            self._device = DeviceArrays(
+                totals=jnp.asarray(self._alloc["totals"]),
+                used=jnp.asarray(self._alloc["used"]),
+                eligible=jnp.asarray(self._alloc["eligible"]),
+                attr_hash=jnp.asarray(self._alloc["attr_hash"]),
+                attr_num=jnp.asarray(self._alloc["attr_num"]),
+                attr_ver=jnp.asarray(self._alloc["attr_ver"]),
+                class_id=jnp.asarray(self._alloc["class_id"]),
+                dev_total=jnp.asarray(self._alloc["dev_total"]),
+                dev_used=jnp.asarray(self._alloc["dev_used"]),
+                prio_used=jnp.asarray(self._alloc["prio_used"]),
+            )
+            self._device_valid = True
+            self._dirty.clear()
+            return self._device
+
+        if self._dirty:
+            rows = np.fromiter(self._dirty, np.int32)
+            idx = jnp.asarray(rows)
+            d = self._device
+            self._device = DeviceArrays(
+                totals=d.totals.at[idx].set(jnp.asarray(self._alloc["totals"][rows])),
+                used=d.used.at[idx].set(jnp.asarray(self._alloc["used"][rows])),
+                eligible=d.eligible.at[idx].set(
+                    jnp.asarray(self._alloc["eligible"][rows])
+                ),
+                attr_hash=d.attr_hash.at[idx].set(
+                    jnp.asarray(self._alloc["attr_hash"][rows])
+                ),
+                attr_num=d.attr_num.at[idx].set(
+                    jnp.asarray(self._alloc["attr_num"][rows])
+                ),
+                attr_ver=d.attr_ver.at[idx].set(
+                    jnp.asarray(self._alloc["attr_ver"][rows])
+                ),
+                class_id=d.class_id.at[idx].set(
+                    jnp.asarray(self._alloc["class_id"][rows])
+                ),
+                dev_total=d.dev_total.at[idx].set(
+                    jnp.asarray(self._alloc["dev_total"][rows])
+                ),
+                dev_used=d.dev_used.at[idx].set(
+                    jnp.asarray(self._alloc["dev_used"][rows])
+                ),
+                prio_used=d.prio_used.at[idx].set(
+                    jnp.asarray(self._alloc["prio_used"][rows])
+                ),
+            )
+            self._dirty.clear()
+        return self._device
+
+    def invalidate(self) -> None:
+        self._device_valid = False

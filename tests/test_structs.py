@@ -1,0 +1,171 @@
+"""Tests for core types and the scalar scheduling math oracle.
+
+Mirrors the reference's funcs_test.go behavior checks for AllocsFit and
+ScoreFitBinPack/Spread (nomad/structs/funcs.go:97,186,213).
+"""
+
+import math
+
+from nomad_tpu.structs import (
+    Allocation,
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Job,
+    Node,
+    NodeReservedResources,
+    NodeResources,
+    NetworkResource,
+    Resources,
+    Task,
+    TaskGroup,
+    allocs_fit,
+    net_priority,
+    preemption_score,
+    score_fit_binpack,
+    score_fit_spread,
+    score_normalize,
+)
+
+
+def make_node(cpu=4000, mem=8192, disk=100 * 1024, rcpu=0, rmem=0):
+    return Node(
+        resources=NodeResources(cpu=cpu, memory_mb=mem, disk_mb=disk),
+        reserved=NodeReservedResources(cpu=rcpu, memory_mb=rmem),
+    )
+
+
+def make_alloc(cpu=1000, mem=1024, disk=0, **kw):
+    return Allocation(resources=Resources(cpu=cpu, memory_mb=mem, disk_mb=disk), **kw)
+
+
+class TestAllocsFit:
+    def test_fits(self):
+        node = make_node()
+        fit, dim, used = allocs_fit(node, [make_alloc(), make_alloc()])
+        assert fit and dim == ""
+        assert used.cpu == 2000 and used.memory_mb == 2048
+
+    def test_cpu_exhausted(self):
+        node = make_node(cpu=1500)
+        fit, dim, _ = allocs_fit(node, [make_alloc(), make_alloc()])
+        assert not fit and dim == "cpu"
+
+    def test_memory_exhausted(self):
+        node = make_node(mem=1024)
+        fit, dim, _ = allocs_fit(node, [make_alloc(), make_alloc()])
+        assert not fit and dim == "memory"
+
+    def test_reserved_subtracted(self):
+        # Node reserved resources shrink availability (funcs.go:130-131).
+        node = make_node(cpu=2000, rcpu=500)
+        fit, dim, _ = allocs_fit(node, [make_alloc(cpu=1800, mem=100)])
+        assert not fit and dim == "cpu"
+
+    def test_terminal_allocs_ignored(self):
+        node = make_node(cpu=1000)
+        dead = make_alloc(client_status=AllocClientStatus.FAILED.value)
+        stopped = make_alloc(desired_status=AllocDesiredStatus.STOP.value)
+        fit, _, used = allocs_fit(node, [dead, stopped, make_alloc()])
+        assert fit and used.cpu == 1000
+
+    def test_port_collision(self):
+        node = make_node()
+        a = make_alloc()
+        a.resources.networks = [NetworkResource(reserved_ports=[8080])]
+        b = make_alloc()
+        b.resources.networks = [NetworkResource(reserved_ports=[8080])]
+        fit, dim, _ = allocs_fit(node, [a, b])
+        assert not fit and dim == "reserved port collision"
+
+    def test_device_oversubscription(self):
+        node = make_node()
+        node.resources.devices = {"gpu": ["gpu0"]}
+        from nomad_tpu.structs import RequestedDevice
+
+        a = make_alloc()
+        a.resources.devices = [RequestedDevice(name="gpu", count=2)]
+        fit, dim, _ = allocs_fit(node, [a], check_devices=True)
+        assert not fit and dim == "devices"
+        fit, _, _ = allocs_fit(node, [a], check_devices=False)
+        assert fit
+
+
+class TestScoreFit:
+    def test_binpack_perfect_fit(self):
+        # 100% utilization → 20 − (10^0 + 10^0) = 18.
+        node = make_node(cpu=2000, mem=2048)
+        util = Resources(cpu=2000, memory_mb=2048)
+        assert math.isclose(score_fit_binpack(node, util), 18.0)
+
+    def test_binpack_empty(self):
+        # 0% utilization → 20 − (10 + 10) = 0.
+        node = make_node(cpu=2000, mem=2048)
+        util = Resources(cpu=0, memory_mb=0)
+        assert math.isclose(score_fit_binpack(node, util), 0.0)
+
+    def test_binpack_half(self):
+        # 50%/50% → 20 − 2·10^0.5 ≈ 13.675.
+        node = make_node(cpu=2000, mem=2048)
+        util = Resources(cpu=1000, memory_mb=1024)
+        expected = 20.0 - 2.0 * math.pow(10, 0.5)
+        assert math.isclose(score_fit_binpack(node, util), expected)
+
+    def test_spread_inverts(self):
+        node = make_node(cpu=2000, mem=2048)
+        empty = Resources(cpu=0, memory_mb=0)
+        full = Resources(cpu=2000, memory_mb=2048)
+        assert math.isclose(score_fit_spread(node, empty), 18.0)
+        assert math.isclose(score_fit_spread(node, full), 0.0)
+
+    def test_reserved_changes_percentages(self):
+        node = make_node(cpu=2000, mem=2048, rcpu=1000, rmem=1024)
+        util = Resources(cpu=1000, memory_mb=1024)
+        assert math.isclose(score_fit_binpack(node, util), 18.0)
+
+
+class TestPreemptionScore:
+    def test_inflection_point(self):
+        # netPriority 2048 → 0.5 (rank.go preemptionScore).
+        assert math.isclose(preemption_score(2048.0), 0.5)
+
+    def test_monotone_decreasing(self):
+        assert preemption_score(100) > preemption_score(1000) > preemption_score(4000)
+
+    def test_net_priority(self):
+        # max + sum/max (rank.go netPriority).
+        assert math.isclose(net_priority([50, 50]), 50 + 100 / 50)
+        assert math.isclose(net_priority([100]), 100 + 1.0)
+        assert net_priority([]) == 0.0
+
+
+class TestTypes:
+    def test_alloc_index_from_name(self):
+        a = Allocation(name="web.cache[3]")
+        assert a.index == 3
+
+    def test_tg_combined_resources(self):
+        tg = TaskGroup(
+            tasks=[
+                Task(resources=Resources(cpu=500, memory_mb=256)),
+                Task(resources=Resources(cpu=250, memory_mb=128)),
+            ]
+        )
+        combined = tg.combined_resources()
+        assert combined.cpu == 750
+        assert combined.memory_mb == 384
+        assert combined.disk_mb == 300  # ephemeral disk default
+
+    def test_node_ready(self):
+        node = make_node()
+        assert node.ready()
+        node.drain = True
+        assert not node.ready()
+
+    def test_score_normalize(self):
+        assert score_normalize([1.0, 0.0]) == 0.5
+        assert score_normalize([]) == 0.0
+
+    def test_job_lookup_tg(self):
+        job = Job(task_groups=[TaskGroup(name="web"), TaskGroup(name="db")])
+        assert job.lookup_task_group("db").name == "db"
+        assert job.lookup_task_group("nope") is None
