@@ -97,6 +97,11 @@ class SchedRequest(NamedTuple):
     s_implicit: np.ndarray  # (S,) f32 — implicit-target desired count (NaN none)
     s_sum_weights: np.ndarray  # () f32
     preempt_bucket: np.ndarray  # () i32 — victims strictly below; -1 disabled
+    # () bool — job carries a distinct_hosts constraint: nodes with any
+    # proposed alloc of this job+TG (tg_count > 0) are hard-infeasible, so the
+    # placement scan cannot stack allocs on one node between host-mask
+    # refreshes (DistinctHostsIterator, feasible.go:505).
+    distinct_hosts: np.ndarray
 
 
 @dataclass
@@ -338,6 +343,9 @@ class RequestEncoder:
             s_implicit=s_implicit,
             s_sum_weights=np.float32(sum_weights if sum_weights else 1.0),
             preempt_bucket=np.int32(preempt_bucket),
+            distinct_hosts=np.bool_(
+                any(c.operand == Op.DISTINCT_HOSTS.value for c in constraints)
+            ),
         )
         return CompiledTaskGroup(
             request=req,

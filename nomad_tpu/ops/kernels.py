@@ -324,6 +324,9 @@ def score_nodes(
     """The full ranking pipeline as one fused program (GenericStack.Select,
     stack.go:117-179, minus the sampling the TPU design makes unnecessary)."""
     feas = feasibility_mask(arrays, req, class_elig, host_mask)
+    # distinct_hosts: one proposed alloc of this job+TG per node, enforced
+    # in-scan via tg_count so multi-placement batches can't stack a node.
+    feas &= ~(req.distinct_hosts & (tg_count > 0))
     fits, binpack, exhausted = fit_and_binpack(arrays, used, req)
 
     # Preemption assist: nodes that don't fit but could after evicting

@@ -1,0 +1,26 @@
+"""Scheduler package — pure logic over a state snapshot.
+
+Mirrors the reference's ``scheduler/`` package boundary: a scheduler is a
+pure function of (snapshot, eval) → plan submitted through a ``Planner``
+(scheduler/scheduler.go:54-119). The ranking pipeline itself runs as
+vectorized kernels on TPU (``nomad_tpu.ops.kernels``); this package is the
+host orchestration around them.
+"""
+
+from .generic import GenericScheduler
+from .system import SystemScheduler
+from .stack import GenericStack, SystemStack
+
+BUILTIN_SCHEDULERS = {
+    "service": lambda *a, **kw: GenericScheduler("service", *a, **kw),
+    "batch": lambda *a, **kw: GenericScheduler("batch", *a, **kw),
+    "system": lambda *a, **kw: SystemScheduler(*a, **kw),
+}
+
+
+def new_scheduler(sched_type: str, snapshot, planner, matrix=None):
+    """Factory (reference: scheduler.NewScheduler, scheduler/scheduler.go:36)."""
+    factory = BUILTIN_SCHEDULERS.get(sched_type)
+    if factory is None:
+        raise ValueError(f"unknown scheduler type {sched_type!r}")
+    return factory(snapshot, planner, matrix)

@@ -1,0 +1,582 @@
+"""Selection stacks — host orchestration of the vectorized ranking pipeline.
+
+The reference's GenericStack is a 14-iterator pull chain walking sampled
+nodes one at a time (scheduler/stack.go:324-417, sampling at :78-91). Here a
+``select`` call compiles the task group once (ops/encode.py), builds the
+plan-adjusted proposed usage, and invokes one fused kernel
+(ops/kernels.place_task_group) that scores **all** nodes and places N allocs
+in a lax.scan — the sampling trade-off disappears because scoring the full
+cluster is one matrix pass on the MXU.
+
+Host-side residue (SURVEY.md §7 hard-part b): combinatorial port/device
+*assignment* happens only for the chosen node; non-vectorizable constraints
+are evaluated per computed class (feasible_host.py); a rare post-check
+failure masks the node and re-runs the kernel.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.encode import CompiledTaskGroup, RequestEncoder, MAX_SPREAD_VALUES
+from ..ops import kernels
+from ..state.matrix import NodeMatrix, node_attributes, stable_hash
+from ..structs.types import (
+    Allocation,
+    AllocMetric,
+    Job,
+    Node,
+    Op,
+    TaskGroup,
+)
+from .context import EvalContext
+from .feasible_host import check_constraint_host, check_host_volumes
+
+# Dynamic port range (reference: structs/network.go MinDynamicPort/MaxDynamicPort).
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 32000
+
+# Placement chunk ceiling: bounds the set of lax.scan lengths the jit cache
+# ever sees to {1, 2, 4, 8, 16} (SURVEY.md §7 hard-part e).
+PLACEMENT_CHUNK = 16
+# Bound on kernel re-entries after host-side rejections (gone node, port
+# conflict) or preemption-assisted picks.
+MAX_SELECT_RETRIES = 8
+
+
+@dataclass
+class SelectionOption:
+    """One placement decision (reference: rank.RankedNode)."""
+
+    node_id: str
+    node: Node
+    row: int
+    final_score: float
+    binpack_score: float
+    needs_preempt: bool
+    metric: AllocMetric = field(default_factory=AllocMetric)
+    # task -> {label: port} assigned host-side for the chosen node
+    assigned_ports: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+
+def _pow2_bucket(n: int) -> int:
+    """Round placement counts up to a power of two so lax.scan lengths (and
+    hence jit cache entries) stay bounded (SURVEY.md §7 hard-part e)."""
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+class GenericStack:
+    """Service/batch ranking stack (reference: stack.go:324-417)."""
+
+    def __init__(
+        self,
+        ctx: EvalContext,
+        matrix: NodeMatrix,
+        algorithm: str = "binpack",
+        preemption_enabled: bool = False,
+        batch: bool = False,
+    ):
+        self.ctx = ctx
+        self.matrix = matrix
+        self.algorithm = algorithm
+        self.preemption_enabled = preemption_enabled
+        self.batch = batch
+        self.encoder = RequestEncoder(matrix)
+        self.job: Optional[Job] = None
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+
+    # -- proposed-state assembly -------------------------------------------
+
+    def _plan_usage_deltas(self) -> Dict[int, np.ndarray]:
+        """Net (cpu, mem, disk) the in-flight plan adds per node row."""
+        deltas: Dict[int, np.ndarray] = {}
+        plan = self.ctx.plan
+
+        def add(node_id: str, res, sign: float) -> None:
+            row = self.matrix.row_of.get(node_id)
+            if row is None:
+                return
+            d = deltas.setdefault(row, np.zeros(3, np.float32))
+            d += sign * np.array([res.cpu, res.memory_mb, res.disk_mb], np.float32)
+
+        for node_id, allocs in plan.node_allocation.items():
+            for a in allocs:
+                add(node_id, a.resources, 1.0)
+        for node_id, allocs in plan.node_update.items():
+            for a in allocs:
+                add(node_id, a.resources, -1.0)
+        for node_id, allocs in plan.node_preemptions.items():
+            for a in allocs:
+                add(node_id, a.resources, -1.0)
+        return deltas
+
+    def _tg_counts(self, job: Job, tg: TaskGroup) -> Dict[int, int]:
+        """Proposed allocs of this job+TG per node row (JobAntiAffinity and
+        distinct_hosts inputs)."""
+        counts: Dict[int, int] = {}
+        plan = self.ctx.plan
+        removed = self.ctx.plan_removed_ids()
+        for a in self.ctx.snapshot.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status() or a.id in removed or a.task_group != tg.name:
+                continue
+            row = self.matrix.row_of.get(a.node_id)
+            if row is not None:
+                counts[row] = counts.get(row, 0) + 1
+        for node_id, allocs in plan.node_allocation.items():
+            n = sum(1 for a in allocs if a.task_group == tg.name)
+            if n:
+                row = self.matrix.row_of.get(node_id)
+                if row is not None:
+                    counts[row] = counts.get(row, 0) + n
+        return counts
+
+    def _spread_counts(
+        self, job: Job, tg: TaskGroup, compiled: CompiledTaskGroup
+    ) -> np.ndarray:
+        """(S, V) usage counts per attribute value, aligned/extended against
+        the compiled s_value_hash table (propertyset.go usage tracking)."""
+        req = compiled.request
+        s_hash = req.s_value_hash.copy()
+        counts = np.zeros_like(s_hash, np.float32)
+        if not compiled.spreads:
+            return counts
+        removed = self.ctx.plan_removed_ids()
+        live = [
+            a
+            for a in self.ctx.snapshot.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status() and a.id not in removed
+            and a.task_group == tg.name
+        ]
+        for allocs in self.ctx.plan.node_allocation.values():
+            live.extend(a for a in allocs if a.task_group == tg.name)
+        for si, sp in enumerate(compiled.spreads[: s_hash.shape[0]]):
+            if req.s_slot[si] < 0:
+                continue
+            name = sp.attribute
+            if name.startswith("${") and name.endswith("}"):
+                name = name[2:-1]
+            if name.startswith("attr."):
+                name = name[len("attr.") :]
+            for a in live:
+                node = self.ctx.snapshot.node_by_id(a.node_id)
+                if node is None:
+                    continue
+                value = node_attributes(node).get(name)
+                if not value:
+                    continue
+                h = stable_hash(value)
+                idx = np.where(s_hash[si] == h)[0]
+                if idx.size:
+                    counts[si, idx[0]] += 1.0
+                else:
+                    free = np.where(s_hash[si] == 0)[0]
+                    if free.size:
+                        s_hash[si, free[0]] = h
+                        counts[si, free[0]] = 1.0
+        # persist discovered values into the request copy used by the kernel
+        compiled.request = req._replace(s_value_hash=s_hash)
+        return counts
+
+    def _class_eligibility(self, compiled: CompiledTaskGroup) -> np.ndarray:
+        """Evaluate escaped non-unique constraints once per computed class
+        (the ComputedClass cache, feasible.go:1029). Returns a padded bool
+        vector indexed by class id."""
+        n_classes = max(1, len(self.matrix.class_ids))
+        pad = _pow2_bucket(n_classes)
+        elig = np.ones((pad,), bool)
+        escaped = [
+            e.constraint
+            for e in compiled.escaped
+            if not e.unique
+            and e.constraint.operand
+            not in (Op.DISTINCT_HOSTS.value, Op.DISTINCT_PROPERTY.value)
+        ]
+        if not escaped:
+            return elig
+        for cid, rep_node_id in self.matrix.class_repr.items():
+            node = self.ctx.snapshot.node_by_id(rep_node_id)
+            if node is None:
+                continue
+            ok = all(check_constraint_host(c, node) for c in escaped)
+            if cid < pad:
+                elig[cid] = ok
+        return elig
+
+    def _host_mask(
+        self, job: Job, tg: TaskGroup, compiled: CompiledTaskGroup
+    ) -> Optional[np.ndarray]:
+        """Per-node mask for unique-attr escapes, distinct_hosts,
+        distinct_property, host volumes, and escaped device asks. None when
+        nothing applies (the common case — no O(N) host walk)."""
+        n = self.matrix.capacity
+        mask: Optional[np.ndarray] = None
+
+        def ensure() -> np.ndarray:
+            nonlocal mask
+            if mask is None:
+                mask = np.ones((n,), bool)
+            return mask
+
+        unique = [e.constraint for e in compiled.escaped if e.unique]
+        distinct_hosts = any(
+            e.constraint.operand == Op.DISTINCT_HOSTS.value for e in compiled.escaped
+        )
+        distinct_props = [
+            e.constraint
+            for e in compiled.escaped
+            if e.constraint.operand == Op.DISTINCT_PROPERTY.value
+        ]
+
+        if unique or compiled.host_volumes or compiled.escaped_devices or compiled.dc_escaped:
+            m = ensure()
+            dcs = set(job.datacenters)
+            for node_id, row in self.matrix.row_of.items():
+                node = self.ctx.snapshot.node_by_id(node_id)
+                if node is None:
+                    m[row] = False
+                    continue
+                if compiled.dc_escaped and node.datacenter not in dcs:
+                    m[row] = False
+                    continue
+                if unique and not all(
+                    check_constraint_host(c, node) for c in unique
+                ):
+                    m[row] = False
+                    continue
+                if compiled.host_volumes and not check_host_volumes(
+                    node, compiled.host_volumes
+                ):
+                    m[row] = False
+                    continue
+                for name, count in compiled.escaped_devices:
+                    if len(node.resources.devices.get(name, [])) < count:
+                        m[row] = False
+                        break
+
+        if distinct_hosts:
+            # Mask nodes already holding a proposed alloc of this job
+            # (DistinctHostsIterator, feasible.go:505).
+            m = ensure()
+            removed = self.ctx.plan_removed_ids()
+            for a in self.ctx.snapshot.allocs_by_job(job.namespace, job.id):
+                if a.terminal_status() or a.id in removed:
+                    continue
+                row = self.matrix.row_of.get(a.node_id)
+                if row is not None:
+                    m[row] = False
+            for node_id, allocs in self.ctx.plan.node_allocation.items():
+                if allocs:
+                    row = self.matrix.row_of.get(node_id)
+                    if row is not None:
+                        m[row] = False
+
+        for con in distinct_props:
+            # DistinctPropertyIterator (feasible.go:604): limit allocs of the
+            # job per distinct value of the property.
+            m = ensure()
+            limit = int(con.r_target) if str(con.r_target).isdigit() else 1
+            name = con.l_target
+            if name.startswith("${") and name.endswith("}"):
+                name = name[2:-1]
+            if name.startswith("attr."):
+                name = name[len("attr.") :]
+            counts: Dict[str, int] = {}
+            removed = self.ctx.plan_removed_ids()
+            live = [
+                a
+                for a in self.ctx.snapshot.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status() and a.id not in removed
+            ]
+            for allocs in self.ctx.plan.node_allocation.values():
+                live.extend(allocs)
+            for a in live:
+                anode = self.ctx.snapshot.node_by_id(a.node_id)
+                if anode is None:
+                    continue
+                v = node_attributes(anode).get(name)
+                if v:
+                    counts[v] = counts.get(v, 0) + 1
+            for node_id, row in self.matrix.row_of.items():
+                node = self.ctx.snapshot.node_by_id(node_id)
+                if node is None:
+                    continue
+                v = node_attributes(node).get(name)
+                if v is not None and counts.get(v, 0) >= limit:
+                    m[row] = False
+        return mask
+
+    # -- port assignment (host-side, chosen node only) ----------------------
+
+    def _assign_ports(
+        self, node: Node, tg: TaskGroup, extra_used: Optional[set] = None
+    ) -> Optional[Dict[str, Dict[str, int]]]:
+        """Assign reserved + dynamic ports on the chosen node; None on
+        conflict (NetworkIndex equivalent, nomad/structs/network.go:35).
+        ``extra_used``: ports handed out earlier in the same select batch,
+        before the plan reflects them."""
+        used = set(node.reserved.reserved_ports)
+        if extra_used:
+            used |= extra_used
+        for a in self.ctx.proposed_allocs(node.id):
+            for nets in a.assigned_ports.values():
+                used.update(nets.values())
+            for net in a.resources.networks:
+                used.update(net.reserved_ports)
+
+        result: Dict[str, Dict[str, int]] = {}
+        nets = list(tg.networks) + [
+            n for t in tg.tasks for n in t.resources.networks
+        ]
+        owners = ["group"] * len(tg.networks) + [
+            t.name for t in tg.tasks for _ in t.resources.networks
+        ]
+        cursor = MIN_DYNAMIC_PORT
+        for net, owner in zip(nets, owners):
+            ports: Dict[str, int] = {}
+            for port in net.reserved_ports:
+                if port in used:
+                    return None
+                used.add(port)
+                ports[str(port)] = port
+            for label in net.dynamic_ports:
+                while cursor in used and cursor <= MAX_DYNAMIC_PORT:
+                    cursor += 1
+                if cursor > MAX_DYNAMIC_PORT:
+                    return None
+                used.add(cursor)
+                ports[label] = cursor
+            if ports:
+                result.setdefault(owner, {}).update(ports)
+        return result
+
+    # -- the main entry ------------------------------------------------------
+
+    def select(
+        self,
+        tg: TaskGroup,
+        n_placements: int = 1,
+        penalty_nodes: Optional[Sequence[str]] = None,
+    ) -> List[Optional[SelectionOption]]:
+        """Place ``n_placements`` allocs of ``tg``; one option (or None) per
+        requested placement (reference: stack.go:117-179 Select, called per
+        missing alloc from generic_sched.go:472)."""
+        assert self.job is not None, "set_job first"
+        job = self.job
+        start = time.monotonic()
+
+        sched_cfg = self.ctx.snapshot.scheduler_config()
+        compiled = self.encoder.compile(
+            job,
+            tg,
+            algorithm=self.algorithm,
+            preemption_enabled=self.preemption_enabled,
+        )
+
+        arrays = self.matrix.sync()
+        n = self.matrix.capacity
+
+        penalty = np.zeros((n,), bool)
+        for node_id in penalty_nodes or []:
+            row = self.matrix.row_of.get(node_id)
+            if row is not None:
+                penalty[row] = True
+
+        class_elig = self._class_eligibility(compiled)
+        base_host_mask = self._host_mask(job, tg, compiled)
+
+        import jax.numpy as jnp
+
+        options: List[Optional[SelectionOption]] = []
+        banned_rows: List[int] = []
+        # Accounting for selections made in *earlier kernel calls of this
+        # select()*: the plan only learns about them after select returns, so
+        # later chunks/retries must fold them in here to avoid over-commit.
+        chosen_rows: List[int] = []
+        chosen_ports: Dict[str, set] = {}
+        remaining = n_placements
+        retries = 0
+        while remaining > 0 and retries <= MAX_SELECT_RETRIES:
+            host_mask = base_host_mask
+            if banned_rows:
+                host_mask = (
+                    np.ones((n,), bool) if host_mask is None else host_mask.copy()
+                )
+                host_mask[banned_rows] = False
+
+            deltas = self._plan_usage_deltas()
+            for row in chosen_rows:
+                d = deltas.setdefault(row, np.zeros(3, np.float32))
+                d += np.asarray(compiled.request.ask, np.float32)
+            used0 = arrays.used
+            if deltas:
+                rows = np.fromiter(deltas.keys(), np.int32)
+                dvals = np.stack([deltas[r] for r in rows])
+                used0 = used0.at[jnp.asarray(rows)].add(jnp.asarray(dvals))
+
+            tg_counts = self._tg_counts(job, tg)
+            for row in chosen_rows:
+                tg_counts[row] = tg_counts.get(row, 0) + 1
+            tg_count = np.zeros((n,), np.int32)
+            for row, c in tg_counts.items():
+                tg_count[row] = c
+
+            spread_counts = self._spread_counts(job, tg, compiled)
+
+            # Fixed chunk ceiling keeps the set of lax.scan lengths (and thus
+            # jit compilations) bounded: {1,2,4,...,PLACEMENT_CHUNK} only.
+            bucket = min(_pow2_bucket(remaining), PLACEMENT_CHUNK)
+            result = kernels.place_task_group(
+                arrays,
+                compiled.request,
+                used0,
+                jnp.asarray(tg_count),
+                jnp.asarray(spread_counts),
+                jnp.asarray(penalty),
+                jnp.asarray(class_elig),
+                jnp.asarray(
+                    host_mask
+                    if host_mask is not None
+                    else np.ones((n,), bool)
+                ),
+                n_placements=bucket,
+            )
+            take = min(bucket, remaining)
+            rows_out = np.asarray(result.rows)[:take]
+            scores = np.asarray(result.scores)[:take]
+            binpack = np.asarray(result.binpack)[:take]
+            preempted = np.asarray(result.preempted)[:take]
+            n_eval = np.asarray(result.nodes_evaluated)[:take]
+            n_filt = np.asarray(result.nodes_filtered)[:take]
+            n_exh = np.asarray(result.nodes_exhausted)[:take]
+
+            retry = False
+            for i, row in enumerate(rows_out):
+                metric = AllocMetric(
+                    nodes_evaluated=int(n_eval[i]),
+                    nodes_filtered=int(n_filt[i]),
+                    nodes_exhausted=int(n_exh[i]),
+                )
+                metric.allocation_time = time.monotonic() - start
+                if row < 0:
+                    options.append(None)
+                    remaining -= 1
+                    continue
+                node_id = self.matrix.node_of.get(int(row))
+                node = (
+                    self.ctx.snapshot.node_by_id(node_id) if node_id else None
+                )
+                if node is None:
+                    banned_rows.append(int(row))
+                    retries += 1
+                    retry = True
+                    break
+                # Host-side combinatorial residue: port assignment, aware of
+                # ports handed out earlier in this same batch.
+                ports = self._assign_ports(
+                    node, tg, extra_used=chosen_ports.get(node_id)
+                )
+                if ports is None:
+                    banned_rows.append(int(row))
+                    retries += 1
+                    retry = True
+                    break
+                metric.score_node(node_id, "binpack", float(binpack[i]))
+                metric.score_node(node_id, "final", float(scores[i]))
+                opt = SelectionOption(
+                    node_id=node_id,
+                    node=node,
+                    row=int(row),
+                    final_score=float(scores[i]),
+                    binpack_score=float(binpack[i]),
+                    needs_preempt=bool(preempted[i]),
+                    metric=metric,
+                    assigned_ports=ports,
+                )
+                options.append(opt)
+                chosen_rows.append(int(row))
+                if ports:
+                    bag = chosen_ports.setdefault(node_id, set())
+                    for per_task in ports.values():
+                        bag.update(per_task.values())
+                remaining -= 1
+                if bool(preempted[i]):
+                    # A preemption-assisted pick changes proposed state in a
+                    # way the in-scan accounting can't see (victims are chosen
+                    # host-side afterwards); re-enter conservatively — the
+                    # chosen_rows delta keeps this node's ask accounted.
+                    retries += 1
+                    retry = True
+                    break
+            if not retry:
+                # Results beyond `take` from this chunk are discarded;
+                # remaining placements loop around with updated accounting.
+                continue
+
+        while len(options) < n_placements:
+            options.append(None)
+        return options
+
+
+class SystemStack(GenericStack):
+    """System-job stack: feasibility for every node at once
+    (reference: stack.go:183-321; the system scheduler places one alloc per
+    feasible node, system_sched.go:22-54)."""
+
+    def feasible_nodes(self, tg: TaskGroup) -> Tuple[List[str], AllocMetric]:
+        assert self.job is not None
+        job = self.job
+        compiled = self.encoder.compile(
+            job, tg, algorithm=self.algorithm, preemption_enabled=False
+        )
+        arrays = self.matrix.sync()
+        import jax.numpy as jnp
+
+        class_elig = self._class_eligibility(compiled)
+        host_mask = self._host_mask(job, tg, compiled)
+        n = self.matrix.capacity
+
+        # Fit must judge the node *without* this job's own TG alloc — a
+        # re-evaluation replaces it, it doesn't stack a second copy — and
+        # with the in-flight plan's stops/placements folded in.
+        deltas = self._plan_usage_deltas()
+        for a in self.ctx.snapshot.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status() or a.task_group != tg.name:
+                continue
+            row = self.matrix.row_of.get(a.node_id)
+            if row is None:
+                continue
+            d = deltas.setdefault(row, np.zeros(3, np.float32))
+            r = a.resources
+            d -= np.array([r.cpu, r.memory_mb, r.disk_mb], np.float32)
+        used0 = arrays.used
+        if deltas:
+            rows = np.fromiter(deltas.keys(), np.int32)
+            dvals = np.stack([deltas[r] for r in rows])
+            used0 = used0.at[jnp.asarray(rows)].add(jnp.asarray(dvals))
+
+        mask = kernels.feasibility_mask(
+            arrays,
+            compiled.request,
+            jnp.asarray(class_elig),
+            jnp.asarray(host_mask if host_mask is not None else np.ones((n,), bool)),
+        )
+        fits, _, _ = kernels.fit_and_binpack(arrays, used0, compiled.request)
+        ok = np.asarray(mask & fits)
+        metric = AllocMetric(
+            nodes_evaluated=int(np.asarray(mask).sum()),
+            nodes_filtered=int((~np.asarray(mask)).sum()),
+            nodes_exhausted=int((np.asarray(mask) & ~np.asarray(fits)).sum()),
+        )
+        out = []
+        for row in np.nonzero(ok)[0]:
+            node_id = self.matrix.node_of.get(int(row))
+            if node_id is not None:
+                out.append(node_id)
+        return out, metric

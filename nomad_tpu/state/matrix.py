@@ -351,6 +351,20 @@ class NodeMatrix:
         if row is None:
             return
         del self.node_of[row]
+        # Re-seat the computed-class representative if this node held it:
+        # escaped-constraint checks are evaluated against the representative
+        # (stack._class_eligibility), so a stale id would skip them.
+        cid = int(self._alloc["class_id"][row])
+        if cid >= 0 and self.class_repr.get(cid) == node_id:
+            replacement = None
+            for other_row, other_id in self.node_of.items():
+                if int(self._alloc["class_id"][other_row]) == cid:
+                    replacement = other_id
+                    break
+            if replacement is None:
+                self.class_repr.pop(cid, None)
+            else:
+                self.class_repr[cid] = replacement
         for k in ("totals", "used", "dev_total", "dev_used"):
             self._alloc[k][row] = 0
         self._alloc["eligible"][row] = False

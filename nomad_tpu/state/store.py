@@ -1,0 +1,604 @@
+"""Host-side state store — the authoritative object store.
+
+The reference keeps all cluster state in an in-memory MVCC database
+(go-memdb immutable radix trees, ``nomad/state/state_store.go``, 19 tables
+``nomad/state/schema.go:85-901``) replicated through Raft, with point-in-time
+snapshots and blocking queries via WatchSets.
+
+This build keeps the *discipline* and adapts the mechanism:
+
+- **Immutability discipline.** Objects handed to the store are owned by it
+  and MUST NOT be mutated afterwards; updates insert replacement copies in a
+  single reference assignment (atomic under the GIL). Readers therefore never
+  observe torn objects.
+- **Snapshot indices, not copied tables.** ``snapshot()`` captures the
+  current raft-style ``latest_index`` and reads through to the live tables.
+  This is weaker than memdb's true point-in-time snapshots, but the
+  reference's own architecture makes it sound: schedulers are *optimistic*
+  and every plan is re-verified serially against authoritative state at
+  commit time (``nomad/plan_apply.go:49-69`` design note). The applier is
+  the single writer, so its view is always consistent.
+- **Blocking queries.** ``wait_for_index`` blocks until the store reaches a
+  raft index (the worker's snapshot-min-index sync point,
+  ``nomad/worker.go:228``); table watches wake subscribers on any bump of a
+  table index (memdb WatchSet equivalent, ``state_store.go:198``).
+
+The store also forwards node/alloc deltas to the device-resident
+``NodeMatrix`` so HBM state tracks the authoritative log incrementally
+(SURVEY.md §7 hard-part a).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from ..structs.types import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Allocation,
+    Deployment,
+    EvalStatus,
+    Evaluation,
+    Job,
+    JobStatus,
+    JobType,
+    Node,
+    NodeSchedulingEligibility,
+    NodeStatus,
+    SchedulerConfiguration,
+)
+from .matrix import NodeMatrix
+
+
+class JobSummary:
+    """Per-job TG status counts (reference: structs.JobSummary, maintained by
+    state-store triggers nomad/state/state_store.go setJobSummary)."""
+
+    def __init__(self, job_id: str, namespace: str = "default"):
+        self.job_id = job_id
+        self.namespace = namespace
+        # tg -> {queued, complete, failed, running, starting, lost}
+        self.summary: Dict[str, Dict[str, int]] = {}
+        self.children_pending = 0
+        self.children_running = 0
+        self.children_dead = 0
+        self.create_index = 0
+        self.modify_index = 0
+
+
+class StateStore:
+    """Authoritative in-memory store + device-matrix feed.
+
+    All mutating methods take an explicit raft-style ``index`` (monotonic);
+    the FSM/applier is responsible for ordering. Reads may be performed from
+    any thread.
+    """
+
+    def __init__(self, matrix: Optional[NodeMatrix] = None):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self.matrix = matrix if matrix is not None else NodeMatrix()
+
+        self.latest_index = 0
+        self._table_index: Dict[str, int] = {}
+
+        # Primary tables (id -> object).
+        self.nodes: Dict[str, Node] = {}
+        self.jobs: Dict[Tuple[str, str], Job] = {}  # (namespace, id)
+        self.job_versions: Dict[Tuple[str, str], List[Job]] = {}
+        self.evals: Dict[str, Evaluation] = {}
+        self.allocs: Dict[str, Allocation] = {}
+        self.deployments: Dict[str, Deployment] = {}
+        self.job_summaries: Dict[Tuple[str, str], JobSummary] = {}
+        self.periodic_launch: Dict[Tuple[str, str], float] = {}
+        self.scheduler_config = SchedulerConfiguration()
+
+        # Secondary indexes (sets of ids).
+        self._allocs_by_node: Dict[str, Set[str]] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], Set[str]] = {}
+        self._allocs_by_eval: Dict[str, Set[str]] = {}
+        self._evals_by_job: Dict[Tuple[str, str], Set[str]] = {}
+        self._deployments_by_job: Dict[Tuple[str, str], Set[str]] = {}
+
+    # ------------------------------------------------------------------
+    # Index bookkeeping / blocking queries
+    # ------------------------------------------------------------------
+
+    def _bump(self, table: str, index: int) -> None:
+        self.latest_index = max(self.latest_index, index)
+        self._table_index[table] = max(self._table_index.get(table, 0), index)
+        self._cond.notify_all()
+
+    def table_index(self, table: str) -> int:
+        with self._lock:
+            return self._table_index.get(table, 0)
+
+    def wait_for_index(self, index: int, timeout: Optional[float] = None) -> bool:
+        """Block until ``latest_index >= index`` (worker.go:228 sync point)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self.latest_index >= index, timeout=timeout
+            )
+
+    def wait_for_table(
+        self, table: str, min_index: int, timeout: Optional[float] = None
+    ) -> int:
+        """Blocking query: wait until a table index exceeds ``min_index``;
+        returns the current table index (memdb WatchSet equivalent)."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._table_index.get(table, 0) > min_index,
+                timeout=timeout,
+            )
+            return self._table_index.get(table, 0)
+
+    def snapshot(self) -> "StateSnapshot":
+        with self._lock:
+            return StateSnapshot(self, self.latest_index)
+
+    # ------------------------------------------------------------------
+    # Nodes
+    # ------------------------------------------------------------------
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            prev = self.nodes.get(node.id)
+            node.modify_index = index
+            if prev is None:
+                node.create_index = index
+            else:
+                node.create_index = prev.create_index
+            self.nodes[node.id] = node
+            self.matrix.upsert_node(node)
+            self._bump("nodes", index)
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            if self.nodes.pop(node_id, None) is not None:
+                self.matrix.remove_node(node_id)
+                self._bump("nodes", index)
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        with self._lock:
+            prev = self.nodes.get(node_id)
+            if prev is None:
+                return
+            import copy as _copy
+
+            node = _copy.copy(prev)
+            node.status = status
+            node.modify_index = index
+            node.status_updated_at = index  # logical clock; wall time set by caller
+            self.nodes[node_id] = node
+            self.matrix.upsert_node(node)
+            self._bump("nodes", index)
+
+    def update_node_eligibility(
+        self, index: int, node_id: str, eligibility: str
+    ) -> None:
+        with self._lock:
+            prev = self.nodes.get(node_id)
+            if prev is None:
+                return
+            import copy as _copy
+
+            node = _copy.copy(prev)
+            node.scheduling_eligibility = eligibility
+            node.modify_index = index
+            self.nodes[node_id] = node
+            self.matrix.upsert_node(node)
+            self._bump("nodes", index)
+
+    def update_node_drain(
+        self, index: int, node_id: str, drain_strategy, mark_eligible: bool = False
+    ) -> None:
+        with self._lock:
+            prev = self.nodes.get(node_id)
+            if prev is None:
+                return
+            import copy as _copy
+
+            node = _copy.copy(prev)
+            node.drain_strategy = drain_strategy
+            node.drain = drain_strategy is not None
+            if node.drain:
+                node.scheduling_eligibility = (
+                    NodeSchedulingEligibility.INELIGIBLE.value
+                )
+            elif mark_eligible:
+                node.scheduling_eligibility = NodeSchedulingEligibility.ELIGIBLE.value
+            node.modify_index = index
+            self.nodes[node_id] = node
+            self.matrix.upsert_node(node)
+            self._bump("nodes", index)
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self.nodes.get(node_id)
+
+    def ready_nodes_in_dcs(self, datacenters: Iterable[str]) -> List[Node]:
+        dcs = set(datacenters)
+        return [
+            n
+            for n in self.nodes.values()
+            if n.ready() and (not dcs or n.datacenter in dcs)
+        ]
+
+    # ------------------------------------------------------------------
+    # Jobs
+    # ------------------------------------------------------------------
+
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            key = (job.namespace, job.id)
+            prev = self.jobs.get(key)
+            job.modify_index = index
+            job.job_modify_index = index
+            if prev is None:
+                job.create_index = index
+                job.version = 0
+            else:
+                job.create_index = prev.create_index
+                if self._job_spec_changed(prev, job):
+                    job.version = prev.version + 1
+                else:
+                    job.version = prev.version
+            self.jobs[key] = job
+            versions = self.job_versions.setdefault(key, [])
+            versions.append(job)
+            del versions[:-6]  # JobTrackedVersions default
+            if key not in self.job_summaries:
+                summary = JobSummary(job.id, job.namespace)
+                summary.create_index = index
+                for tg in job.task_groups:
+                    summary.summary[tg.name] = {}
+                self.job_summaries[key] = summary
+            self._bump("jobs", index)
+
+    @staticmethod
+    def _job_spec_changed(a: Job, b: Job) -> bool:
+        """Conservative spec-change check driving version bumps."""
+        import dataclasses
+
+        ax = dataclasses.asdict(a)
+        bx = dataclasses.asdict(b)
+        for k in (
+            "version",
+            "create_index",
+            "modify_index",
+            "job_modify_index",
+            "submit_time",
+            "status",
+        ):
+            ax.pop(k, None)
+            bx.pop(k, None)
+        return ax != bx
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            key = (namespace, job_id)
+            if self.jobs.pop(key, None) is not None:
+                self.job_versions.pop(key, None)
+                self.job_summaries.pop(key, None)
+                self.periodic_launch.pop(key, None)
+                self._bump("jobs", index)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self.jobs.get((namespace, job_id))
+
+    def job_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
+        for j in self.job_versions.get((namespace, job_id), []):
+            if j.version == version:
+                return j
+        return None
+
+    def jobs_by_namespace(self, namespace: str) -> List[Job]:
+        return [j for (ns, _), j in self.jobs.items() if ns == namespace]
+
+    def all_jobs(self) -> List[Job]:
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # Evaluations
+    # ------------------------------------------------------------------
+
+    def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
+        with self._lock:
+            for ev in evals:
+                prev = self.evals.get(ev.id)
+                ev.modify_index = index
+                if prev is None:
+                    ev.create_index = index
+                else:
+                    ev.create_index = prev.create_index
+                self.evals[ev.id] = ev
+                self._evals_by_job.setdefault((ev.namespace, ev.job_id), set()).add(
+                    ev.id
+                )
+            self._bump("evals", index)
+
+    def delete_eval(self, index: int, eval_id: str) -> None:
+        with self._lock:
+            ev = self.evals.pop(eval_id, None)
+            if ev is not None:
+                ids = self._evals_by_job.get((ev.namespace, ev.job_id))
+                if ids:
+                    ids.discard(eval_id)
+                self._bump("evals", index)
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self.evals.get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        ids = self._evals_by_job.get((namespace, job_id), set())
+        return [self.evals[i] for i in ids if i in self.evals]
+
+    # ------------------------------------------------------------------
+    # Allocations
+    # ------------------------------------------------------------------
+
+    def _index_alloc(self, alloc: Allocation) -> None:
+        self._allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+        self._allocs_by_job.setdefault(
+            (alloc.namespace, alloc.job_id), set()
+        ).add(alloc.id)
+        if alloc.eval_id:
+            self._allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+
+    def _unindex_alloc(self, alloc: Allocation) -> None:
+        s = self._allocs_by_node.get(alloc.node_id)
+        if s:
+            s.discard(alloc.id)
+        s = self._allocs_by_job.get((alloc.namespace, alloc.job_id))
+        if s:
+            s.discard(alloc.id)
+        s = self._allocs_by_eval.get(alloc.eval_id)
+        if s:
+            s.discard(alloc.id)
+
+    def upsert_allocs(self, index: int, allocs: Iterable[Allocation]) -> None:
+        """Insert/replace allocations, keeping the device matrix in sync."""
+        with self._lock:
+            for alloc in allocs:
+                prev = self.allocs.get(alloc.id)
+                alloc.modify_index = index
+                if prev is None:
+                    alloc.create_index = index
+                    alloc.alloc_modify_index = index
+                else:
+                    alloc.create_index = prev.create_index
+                    alloc.alloc_modify_index = index
+
+                # Matrix delta: usage counts only while non-terminal.
+                was_live = prev is not None and not prev.terminal_status()
+                is_live = not alloc.terminal_status()
+                if was_live and not is_live:
+                    self.matrix.remove_alloc(prev)
+                elif not was_live and is_live:
+                    self.matrix.add_alloc(alloc)
+                elif was_live and is_live and prev.node_id != alloc.node_id:
+                    self.matrix.remove_alloc(prev)
+                    self.matrix.add_alloc(alloc)
+
+                if prev is not None:
+                    self._unindex_alloc(prev)
+                self.allocs[alloc.id] = alloc
+                self._index_alloc(alloc)
+                self._update_summary(alloc, prev, index)
+
+                # Stamp the replaced alloc so it is never rescheduled twice
+                # (reference: UpsertAllocs sets NextAllocation on the
+                # previous alloc, nomad/state/state_store.go).
+                if alloc.previous_allocation:
+                    old = self.allocs.get(alloc.previous_allocation)
+                    if old is not None and old.next_allocation != alloc.id:
+                        import copy as _copy
+
+                        old2 = _copy.copy(old)
+                        old2.next_allocation = alloc.id
+                        old2.modify_index = index
+                        self.allocs[old2.id] = old2
+            self._bump("allocs", index)
+
+    def update_allocs_from_client(
+        self, index: int, updates: Iterable[Allocation]
+    ) -> None:
+        """Client status updates (Node.UpdateAlloc path,
+        nomad/node_endpoint.go:1054): merge client fields into stored alloc."""
+        with self._lock:
+            merged = []
+            for upd in updates:
+                prev = self.allocs.get(upd.id)
+                if prev is None:
+                    continue
+                import copy as _copy
+
+                alloc = _copy.copy(prev)
+                alloc.client_status = upd.client_status
+                alloc.client_description = upd.client_description
+                alloc.task_states = upd.task_states
+                alloc.deployment_status = upd.deployment_status
+                merged.append(alloc)
+            if merged:
+                self.upsert_allocs(index, merged)
+
+    def delete_alloc(self, index: int, alloc_id: str) -> None:
+        with self._lock:
+            alloc = self.allocs.pop(alloc_id, None)
+            if alloc is not None:
+                if not alloc.terminal_status():
+                    self.matrix.remove_alloc(alloc)
+                self._unindex_alloc(alloc)
+                self._bump("allocs", index)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self.allocs.get(alloc_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        ids = self._allocs_by_node.get(node_id, set())
+        return [self.allocs[i] for i in ids if i in self.allocs]
+
+    def allocs_by_job(
+        self, namespace: str, job_id: str, anystate: bool = True
+    ) -> List[Allocation]:
+        ids = self._allocs_by_job.get((namespace, job_id), set())
+        return [self.allocs[i] for i in ids if i in self.allocs]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        ids = self._allocs_by_eval.get(eval_id, set())
+        return [self.allocs[i] for i in ids if i in self.allocs]
+
+    def _update_summary(
+        self, alloc: Allocation, prev: Optional[Allocation], index: int
+    ) -> None:
+        summary = self.job_summaries.get((alloc.namespace, alloc.job_id))
+        if summary is None:
+            return
+        tg = summary.summary.setdefault(alloc.task_group, {})
+
+        def bucket(a: Allocation) -> Optional[str]:
+            if a.desired_status == AllocDesiredStatus.RUN.value:
+                return {
+                    AllocClientStatus.PENDING.value: "starting",
+                    AllocClientStatus.RUNNING.value: "running",
+                    AllocClientStatus.COMPLETE.value: "complete",
+                    AllocClientStatus.FAILED.value: "failed",
+                    AllocClientStatus.LOST.value: "lost",
+                }.get(a.client_status)
+            return {
+                AllocClientStatus.COMPLETE.value: "complete",
+                AllocClientStatus.FAILED.value: "failed",
+                AllocClientStatus.LOST.value: "lost",
+            }.get(a.client_status)
+
+        if prev is not None:
+            b = bucket(prev)
+            if b and tg.get(b, 0) > 0:
+                tg[b] -= 1
+        b = bucket(alloc)
+        if b:
+            tg[b] = tg.get(b, 0) + 1
+        summary.modify_index = index
+
+    # ------------------------------------------------------------------
+    # Deployments
+    # ------------------------------------------------------------------
+
+    def upsert_deployment(self, index: int, deployment: Deployment) -> None:
+        with self._lock:
+            prev = self.deployments.get(deployment.id)
+            deployment.modify_index = index
+            if prev is None:
+                deployment.create_index = index
+            else:
+                deployment.create_index = prev.create_index
+            self.deployments[deployment.id] = deployment
+            self._deployments_by_job.setdefault(
+                (deployment.namespace, deployment.job_id), set()
+            ).add(deployment.id)
+            self._bump("deployment", index)
+
+    def delete_deployment(self, index: int, deployment_id: str) -> None:
+        with self._lock:
+            d = self.deployments.pop(deployment_id, None)
+            if d is not None:
+                ids = self._deployments_by_job.get((d.namespace, d.job_id))
+                if ids:
+                    ids.discard(deployment_id)
+                self._bump("deployment", index)
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self.deployments.get(deployment_id)
+
+    def latest_deployment_by_job(
+        self, namespace: str, job_id: str
+    ) -> Optional[Deployment]:
+        ids = self._deployments_by_job.get((namespace, job_id), set())
+        best: Optional[Deployment] = None
+        for i in ids:
+            d = self.deployments.get(i)
+            if d and (best is None or d.create_index > best.create_index):
+                best = d
+        return best
+
+    # ------------------------------------------------------------------
+    # Scheduler config (raft-held runtime knobs; structs/operator.go)
+    # ------------------------------------------------------------------
+
+    def set_scheduler_config(self, index: int, config: SchedulerConfiguration) -> None:
+        with self._lock:
+            self.scheduler_config = config
+            self._bump("scheduler_config", index)
+
+    # ------------------------------------------------------------------
+    # Plan results (UpsertPlanResults, state_store.go:318)
+    # ------------------------------------------------------------------
+
+    def upsert_plan_results(
+        self,
+        index: int,
+        allocs: List[Allocation],
+        stops: List[Allocation],
+        preemptions: List[Allocation],
+        deployment: Optional[Deployment] = None,
+        deployment_updates: Optional[List] = None,
+        evals: Optional[List[Evaluation]] = None,
+    ) -> None:
+        with self._lock:
+            if deployment is not None:
+                self.upsert_deployment(index, deployment)
+            for upd in deployment_updates or []:
+                d = self.deployments.get(upd.deployment_id)
+                if d is not None:
+                    import copy as _copy
+
+                    d2 = _copy.copy(d)
+                    d2.status = upd.status
+                    d2.status_description = upd.status_description
+                    self.upsert_deployment(index, d2)
+            self.upsert_allocs(index, stops + preemptions + allocs)
+            if evals:
+                self.upsert_evals(index, evals)
+
+
+class StateSnapshot:
+    """A scheduler-facing read view pinned at ``snapshot_index``.
+
+    Implements the scheduler ``State`` interface (scheduler/scheduler.go:65).
+    Reads delegate to the live store (see module docstring for why that is
+    sound in this architecture).
+    """
+
+    def __init__(self, store: StateStore, index: int):
+        self.store = store
+        self.snapshot_index = index
+
+    def ready_nodes_in_dcs(self, datacenters) -> List[Node]:
+        return self.store.ready_nodes_in_dcs(datacenters)
+
+    def nodes(self) -> List[Node]:
+        return list(self.store.nodes.values())
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self.store.node_by_id(node_id)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self.store.job_by_id(namespace, job_id)
+
+    def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
+        return self.store.allocs_by_job(namespace, job_id)
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return self.store.allocs_by_node(node_id)
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self.store.eval_by_id(eval_id)
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self.store.deployment_by_id(deployment_id)
+
+    def latest_deployment_by_job(self, namespace: str, job_id: str):
+        return self.store.latest_deployment_by_job(namespace, job_id)
+
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self.store.scheduler_config

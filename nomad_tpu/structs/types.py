@@ -619,6 +619,7 @@ class AllocMetric:
 @dataclass
 class Allocation:
     id: str = ""
+    namespace: str = "default"
     eval_id: str = ""
     name: str = ""  # job.name[tg][index]
     node_id: str = ""
@@ -680,6 +681,15 @@ class Allocation:
 
     def ran_successfully(self) -> bool:
         return self.client_status == AllocClientStatus.COMPLETE.value
+
+    def fail_time(self) -> float:
+        """When this alloc last failed — latest task finish, falling back to
+        modify/create time. Anchors reschedule backoff (reference:
+        Allocation.LastEventTime / NextRescheduleTime, structs.go)."""
+        latest = 0.0
+        for ts in self.task_states.values():
+            latest = max(latest, ts.finished_at)
+        return latest or self.modify_time or self.create_time
 
     def migrate_disk(self) -> bool:
         if self.job is None:
@@ -775,6 +785,10 @@ class Plan:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     # node_id -> allocs preempted to make room
     node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # metadata-only alloc updates (e.g. follow_up_eval_id on failed allocs
+    # awaiting a delayed reschedule) — applied by the applier but excluded
+    # from usage accounting and commit-completeness checks
+    alloc_updates: List[Allocation] = field(default_factory=list)
     deployment: Optional["Deployment"] = None
     deployment_updates: List["DeploymentStatusUpdate"] = field(default_factory=list)
     annotations: Optional[Dict[str, Any]] = None
@@ -786,6 +800,7 @@ class Plan:
         return (
             not self.node_allocation
             and not self.node_update
+            and not self.alloc_updates
             and not self.deployment_updates
             and self.deployment is None
         )
