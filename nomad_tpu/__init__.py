@@ -9,3 +9,17 @@ runs as batched JAX/XLA kernels over a device-resident cluster matrix.
 """
 
 __version__ = "0.1.0"
+
+
+def enable_compilation_cache(path: str = "/tmp/nomad_tpu_jax_cache") -> None:
+    """Opt into JAX's persistent compilation cache.
+
+    The scheduler's p99 budget assumes warm jit caches; the persistent cache
+    makes that true across *processes* too (server restarts, test runs,
+    bench warmup). Call before the first kernel invocation.
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

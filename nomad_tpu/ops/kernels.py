@@ -143,6 +143,16 @@ def feasibility_mask(arrays, req: SchedRequest, class_elig=None, host_mask=None)
     return mask
 
 
+@jax.jit
+def system_feasible(arrays, used0, req: SchedRequest, class_elig, host_mask):
+    """Fused system-scheduler pass: feasibility ∧ fit for every node in one
+    compiled program (SystemStack, stack.go:183-321 — system jobs need no
+    ranking, just the all-node mask)."""
+    mask = feasibility_mask(arrays, req, class_elig, host_mask)
+    fits, _, _ = fit_and_binpack(arrays, used0, req)
+    return mask, fits
+
+
 # ---------------------------------------------------------------------------
 # Scoring
 # ---------------------------------------------------------------------------

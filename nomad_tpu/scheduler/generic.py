@@ -99,6 +99,7 @@ class GenericScheduler:
             priority=eval.priority,
             job=job,
             snapshot_index=snap.snapshot_index,
+            eval_token=eval.leader_ack,
         )
         ctx = EvalContext(snap, plan)
 
@@ -192,6 +193,7 @@ class GenericScheduler:
             batch=self.batch,
         )
         stack.set_job(job)
+        self._stack = stack
 
         # Group placement asks: requests with penalty nodes (reschedules)
         # place one-by-one; the rest batch through one kernel scan.
@@ -290,7 +292,7 @@ class GenericScheduler:
     # ------------------------------------------------------------------
 
     def _finish_eval(self, eval: Evaluation) -> None:
-        updated = Evaluation(**{**eval.__dict__})
+        updated = eval.copy()
         updated.status = EvalStatus.COMPLETE.value
         updated.queued_allocations = dict(self.queued_allocs)
         updated.failed_tg_allocs = dict(self.failed_tg_allocs)
@@ -299,6 +301,7 @@ class GenericScheduler:
         if self.failed_tg_allocs and eval.triggered_by != (
             EvalTrigger.MAX_PLAN_ATTEMPTS.value
         ):
+            stack = getattr(self, "_stack", None)
             blocked = Evaluation(
                 namespace=eval.namespace,
                 priority=eval.priority,
@@ -308,13 +311,21 @@ class GenericScheduler:
                 status=EvalStatus.BLOCKED.value,
                 status_description=BLOCKED_EVAL_FAILED_PLACEMENTS,
                 previous_eval=eval.id,
+                # Unblock keying (blocked_evals.go): which classes we saw
+                # (in)eligible at this snapshot, and whether class caching
+                # escaped to per-node checks.
+                snapshot_index=self.snapshot.snapshot_index,
+                class_eligibility=dict(stack.class_eligibility) if stack else {},
+                escaped_computed_class=(
+                    stack.escaped_computed_class if stack else True
+                ),
             )
             updated.blocked_eval = blocked.id
             self.planner.create_evals([blocked])
         self.planner.update_eval(updated)
 
     def _fail_eval(self, eval: Evaluation, reason: str) -> None:
-        updated = Evaluation(**{**eval.__dict__})
+        updated = eval.copy()
         updated.status = EvalStatus.FAILED.value
         updated.status_description = reason
         self.planner.update_eval(updated)

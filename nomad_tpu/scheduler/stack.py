@@ -25,7 +25,7 @@ import numpy as np
 
 from ..ops.encode import CompiledTaskGroup, RequestEncoder, MAX_SPREAD_VALUES
 from ..ops import kernels
-from ..state.matrix import NodeMatrix, node_attributes, stable_hash
+from ..state.matrix import DEVICE_LOCK, NodeMatrix, node_attributes, stable_hash
 from ..structs.types import (
     Allocation,
     AllocMetric,
@@ -88,9 +88,23 @@ class GenericStack:
         self.batch = batch
         self.encoder = RequestEncoder(matrix)
         self.job: Optional[Job] = None
+        # Eligibility telemetry consumed by blocked-eval creation
+        # (reference: EvalEligibility, context.go:190; fills the eval's
+        # ClassEligibility / EscapedComputedClass fields).
+        self.class_eligibility: Dict[str, bool] = {}
+        self.escaped_computed_class = False
 
     def set_job(self, job: Job) -> None:
         self.job = job
+
+    def _record_eligibility(self, class_elig: np.ndarray, host_mask) -> None:
+        for key, cid in self.matrix.class_ids.items():
+            if cid < len(class_elig):
+                self.class_eligibility[key] = bool(class_elig[cid])
+        if host_mask is not None:
+            # Per-node (class-unhashable) checks were in play — the eval
+            # escapes class caching and must retry on any capacity change.
+            self.escaped_computed_class = True
 
     # -- proposed-state assembly -------------------------------------------
 
@@ -367,6 +381,18 @@ class GenericStack:
         """Place ``n_placements`` allocs of ``tg``; one option (or None) per
         requested placement (reference: stack.go:117-179 Select, called per
         missing alloc from generic_sched.go:472)."""
+        # Whole selection holds the device lock: concurrent workers must not
+        # interleave kernel dispatch on the single-chip client (see
+        # state.matrix.DEVICE_LOCK).
+        with DEVICE_LOCK:
+            return self._select_locked(tg, n_placements, penalty_nodes)
+
+    def _select_locked(
+        self,
+        tg: TaskGroup,
+        n_placements: int = 1,
+        penalty_nodes: Optional[Sequence[str]] = None,
+    ) -> List[Optional[SelectionOption]]:
         assert self.job is not None, "set_job first"
         job = self.job
         start = time.monotonic()
@@ -390,6 +416,7 @@ class GenericStack:
 
         class_elig = self._class_eligibility(compiled)
         base_host_mask = self._host_mask(job, tg, compiled)
+        self._record_eligibility(class_elig, base_host_mask)
 
         import jax.numpy as jnp
 
@@ -530,6 +557,10 @@ class SystemStack(GenericStack):
     feasible node, system_sched.go:22-54)."""
 
     def feasible_nodes(self, tg: TaskGroup) -> Tuple[List[str], AllocMetric]:
+        with DEVICE_LOCK:
+            return self._feasible_nodes_locked(tg)
+
+    def _feasible_nodes_locked(self, tg: TaskGroup) -> Tuple[List[str], AllocMetric]:
         assert self.job is not None
         job = self.job
         compiled = self.encoder.compile(
@@ -540,6 +571,7 @@ class SystemStack(GenericStack):
 
         class_elig = self._class_eligibility(compiled)
         host_mask = self._host_mask(job, tg, compiled)
+        self._record_eligibility(class_elig, host_mask)
         n = self.matrix.capacity
 
         # Fit must judge the node *without* this job's own TG alloc — a
@@ -561,13 +593,13 @@ class SystemStack(GenericStack):
             dvals = np.stack([deltas[r] for r in rows])
             used0 = used0.at[jnp.asarray(rows)].add(jnp.asarray(dvals))
 
-        mask = kernels.feasibility_mask(
+        mask, fits = kernels.system_feasible(
             arrays,
+            used0,
             compiled.request,
             jnp.asarray(class_elig),
             jnp.asarray(host_mask if host_mask is not None else np.ones((n,), bool)),
         )
-        fits, _, _ = kernels.fit_and_binpack(arrays, used0, compiled.request)
         ok = np.asarray(mask & fits)
         metric = AllocMetric(
             nodes_evaluated=int(np.asarray(mask).sum()),

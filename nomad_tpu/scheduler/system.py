@@ -54,6 +54,7 @@ class SystemScheduler:
             priority=eval.priority,
             job=job,
             snapshot_index=snap.snapshot_index,
+            eval_token=eval.leader_ack,
         )
         ctx = EvalContext(snap, plan)
         allocs = snap.allocs_by_job(eval.namespace, eval.job_id)
@@ -158,7 +159,7 @@ class SystemScheduler:
         return True, False
 
     def _finish_eval(self, eval: Evaluation) -> None:
-        updated = Evaluation(**{**eval.__dict__})
+        updated = eval.copy()
         updated.status = EvalStatus.COMPLETE.value
         updated.queued_allocations = dict(self.queued_allocs)
         updated.failed_tg_allocs = dict(self.failed_tg_allocs)

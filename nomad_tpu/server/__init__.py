@@ -1,0 +1,26 @@
+"""Server core — control plane around the TPU scheduler.
+
+The reference's server (``nomad/``) wires a Raft-replicated state store,
+an eval broker, blocked-eval tracking, scheduling workers, and a single
+serialized plan applier (``nomad/server.go:95-257``). This package is the
+TPU-native counterpart: the same control-plane shapes on the host, with the
+plan applier's per-node AllocsFit fan-out (``nomad/plan_apply.go:439-682``)
+replaced by one vectorized kernel over the device-resident node matrix.
+"""
+
+from .eval_broker import EvalBroker
+from .blocked_evals import BlockedEvals
+from .plan_queue import PlanQueue
+from .plan_apply import PlanApplier
+from .worker import Worker
+from .server import Server, ServerConfig
+
+__all__ = [
+    "EvalBroker",
+    "BlockedEvals",
+    "PlanQueue",
+    "PlanApplier",
+    "Worker",
+    "Server",
+    "ServerConfig",
+]

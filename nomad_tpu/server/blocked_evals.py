@@ -1,0 +1,167 @@
+"""Blocked-evaluations tracker.
+
+Reference: ``nomad/blocked_evals.go`` — evals whose placements failed wait
+here until cluster capacity changes. Unblocking is keyed by the node's
+*computed class* (``Block`` :152, ``Unblock`` :404, ``UnblockNode`` :487,
+``watchCapacity`` :508): an eval records which classes it already found
+ineligible; a capacity change on a class it has not seen (or any change, if
+the eval *escaped* class hashing) re-enqueues it. Duplicate blocked evals per
+job are tracked and cancelled by the leader.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..structs.types import EvalStatus, Evaluation
+
+
+class BlockedEvals:
+    def __init__(self, enqueue_fn: Callable[[Evaluation], None]):
+        self._lock = threading.Lock()
+        self._enqueue = enqueue_fn
+        self._enabled = False
+        # eval_id -> eval, split by whether class hashing escaped.
+        self._captured: Dict[str, Evaluation] = {}
+        self._escaped: Dict[str, Evaluation] = {}
+        # (namespace, job_id) -> blocked eval id (one per job; rest are dups).
+        self._jobs: Dict[Tuple[str, str], str] = {}
+        self._duplicates: List[Evaluation] = []
+        # Classes whose capacity changed while nothing was blocked — lets a
+        # Block() racing an Unblock() see the change (b.unblockIndexes).
+        self._unblock_indexes: Dict[str, int] = {}
+        self.stats = {"total_blocked": 0, "total_escaped": 0, "total_quota_limit": 0}
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                self._captured.clear()
+                self._escaped.clear()
+                self._jobs.clear()
+                self._duplicates.clear()
+                self._unblock_indexes.clear()
+
+    # ------------------------------------------------------------------
+
+    def block(self, ev: Evaluation) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            key = (ev.namespace, ev.job_id)
+            existing = self._jobs.get(key)
+            if existing is not None and existing != ev.id:
+                # Duplicate blocked eval for the job: keep latest, cancel rest
+                # (blocked_evals.go:199-219).
+                old = self._captured.pop(existing, None) or self._escaped.pop(
+                    existing, None
+                )
+                if old is not None:
+                    self._duplicates.append(old)
+            self._jobs[key] = ev.id
+
+            # Missed-unblock check: capacity changed on a class this eval
+            # hasn't marked ineligible since it was snapshotted.
+            if self._missed_unblock_locked(ev):
+                del self._jobs[key]
+                self._enqueue_unblocked_locked([ev])
+                return
+
+            if ev.escaped_computed_class:
+                self._escaped[ev.id] = ev
+                self.stats["total_escaped"] += 1
+            else:
+                self._captured[ev.id] = ev
+            self.stats["total_blocked"] += 1
+
+    def _missed_unblock_locked(self, ev: Evaluation) -> bool:
+        for cls, idx in self._unblock_indexes.items():
+            if idx <= ev.snapshot_index:
+                continue
+            elig = ev.class_eligibility.get(cls)
+            if elig is None or elig:
+                # Unseen or eligible class changed after our snapshot.
+                return True
+            if ev.escaped_computed_class:
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+
+    def unblock(self, computed_class: str, index: int) -> None:
+        """Capacity changed on ``computed_class`` (node registered, alloc
+        stopped, drain lifted...). Re-enqueue everything that could now fit."""
+        with self._lock:
+            if not self._enabled:
+                return
+            self._unblock_indexes[computed_class] = index
+            unblock: List[Evaluation] = list(self._escaped.values())
+            self._escaped.clear()
+            still: Dict[str, Evaluation] = {}
+            for ev in self._captured.values():
+                elig = ev.class_eligibility.get(computed_class)
+                if elig is None or elig:
+                    # Eval never saw this class, or saw it eligible (failure
+                    # was capacity, not feasibility) → retry.
+                    unblock.append(ev)
+                else:
+                    still[ev.id] = ev
+            self._captured = still
+            self._enqueue_unblocked_locked(unblock)
+
+    def unblock_all(self, index: int) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            unblock = list(self._escaped.values()) + list(self._captured.values())
+            self._escaped.clear()
+            self._captured.clear()
+            self._enqueue_unblocked_locked(unblock)
+
+    def unblock_node(self, node_id: str, index: int) -> None:
+        """Node-specific unblock used for system jobs when a node joins
+        (blocked_evals.go:487). Without per-node tracking we treat it as an
+        all-class capacity event scoped to system evals."""
+        with self._lock:
+            if not self._enabled:
+                return
+            unblock = [
+                ev
+                for ev in list(self._captured.values()) + list(self._escaped.values())
+                if ev.type == "system"
+            ]
+            for ev in unblock:
+                self._captured.pop(ev.id, None)
+                self._escaped.pop(ev.id, None)
+            self._enqueue_unblocked_locked(unblock)
+
+    def _enqueue_unblocked_locked(self, evals: List[Evaluation]) -> None:
+        for ev in evals:
+            key = (ev.namespace, ev.job_id)
+            if self._jobs.get(key) == ev.id:
+                del self._jobs[key]
+            requeued = ev.copy()
+            requeued.status = EvalStatus.PENDING.value
+            self._enqueue(requeued)
+
+    # ------------------------------------------------------------------
+
+    def untrack(self, namespace: str, job_id: str) -> None:
+        """Job deregistered: drop its blocked eval (blocked_evals.go:Untrack)."""
+        with self._lock:
+            eid = self._jobs.pop((namespace, job_id), None)
+            if eid:
+                self._captured.pop(eid, None)
+                self._escaped.pop(eid, None)
+
+    def duplicates(self) -> List[Evaluation]:
+        """Drain duplicate blocked evals for the leader to cancel
+        (reapDupBlockedEvaluations, nomad/leader.go:593)."""
+        with self._lock:
+            dups, self._duplicates = self._duplicates, []
+            return dups
+
+    def blocked_count(self) -> int:
+        with self._lock:
+            return len(self._captured) + len(self._escaped)

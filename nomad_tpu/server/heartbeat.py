@@ -1,0 +1,69 @@
+"""Node heartbeat TTL tracking.
+
+Reference: ``nomad/heartbeat.go`` (``nodeHeartbeater`` :33-60) — the leader
+keeps a TTL timer per node; a missed heartbeat marks the node ``down``,
+which fans out one evaluation per affected job (``createNodeEvals``) so the
+schedulers replace the lost allocations (§3.3 of SURVEY.md).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, Optional
+
+
+class HeartbeatManager:
+    def __init__(
+        self,
+        on_expire: Callable[[str], None],
+        min_ttl: float = 10.0,
+        max_ttl: float = 20.0,
+    ):
+        self._lock = threading.Lock()
+        self._timers: Dict[str, threading.Timer] = {}
+        self._on_expire = on_expire
+        self.min_ttl = min_ttl
+        self.max_ttl = max_ttl
+        self._enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for t in self._timers.values():
+                    t.cancel()
+                self._timers.clear()
+
+    def reset_heartbeat(self, node_id: str) -> float:
+        """(Re)arm the node's TTL timer; returns the granted TTL. TTLs are
+        jittered to spread thundering herds (heartbeat.go:93)."""
+        ttl = self.min_ttl + random.random() * (self.max_ttl - self.min_ttl)
+        with self._lock:
+            if not self._enabled:
+                return ttl
+            old = self._timers.pop(node_id, None)
+            if old is not None:
+                old.cancel()
+            timer = threading.Timer(ttl, self._expire, args=(node_id,))
+            timer.daemon = True
+            self._timers[node_id] = timer
+            timer.start()
+        return ttl
+
+    def clear_heartbeat(self, node_id: str) -> None:
+        with self._lock:
+            old = self._timers.pop(node_id, None)
+            if old is not None:
+                old.cancel()
+
+    def _expire(self, node_id: str) -> None:
+        with self._lock:
+            if not self._enabled or node_id not in self._timers:
+                return
+            del self._timers[node_id]
+        self._on_expire(node_id)
+
+    def tracked(self) -> int:
+        with self._lock:
+            return len(self._timers)

@@ -1,0 +1,261 @@
+"""Serialized plan applier — the cluster's single commit point.
+
+Reference: ``nomad/plan_apply.go``. Workers produce plans optimistically
+against possibly-stale snapshots; the applier re-verifies every plan against
+the freshest state and commits (possibly partially), handing back a
+``refresh_index`` that sends the scheduler around the retry loop
+(``plan_apply.go:49-69`` design note, ``evaluatePlan`` :400,
+``evaluateNodePlan`` :631-682).
+
+The reference fans per-node ``AllocsFit`` checks out to an EvaluatePool of
+NumCPU/2 goroutines (``plan_apply_pool.go:18``). Here the whole plan is
+verified in ONE ``verify_plan_fit`` kernel call against the authoritative
+device-resident matrix — the same arrays the scheduler scored against, which
+is the north-star "shared kernel" requirement.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.kernels import verify_plan_fit
+from ..structs.types import (
+    Allocation,
+    NodeStatus,
+    Plan,
+    PlanResult,
+)
+from .plan_queue import PendingPlan, PlanQueue
+
+
+class StaleEvalTokenError(Exception):
+    """The submitting worker's eval delivery was superseded (nack-timeout
+    redelivery); its plan must not commit (plan_apply.go token check)."""
+
+
+class PlanApplier:
+    """Single-threaded applier loop over the plan queue."""
+
+    def __init__(self, server):
+        self.server = server
+        self.queue: PlanQueue = server.plan_queue
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.plans_applied = 0
+        self.plans_partial = 0
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="plan-applier", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.apply(pending.plan)
+                pending.respond(result, None)
+            except Exception as exc:  # noqa: BLE001 — fail the submission
+                pending.respond(None, exc)
+
+    # ------------------------------------------------------------------
+
+    def apply(self, plan: Plan) -> PlanResult:
+        """Verify against authoritative state, commit what fits.
+
+        Verification and commit happen under one store lock so no concurrent
+        writer can invalidate the verdict between them — the serialization
+        the reference gets from the Raft log + single applier goroutine.
+        """
+        broker = self.server.eval_broker
+        if plan.eval_token and broker.enabled:
+            current = broker.outstanding_token(plan.eval_id)
+            if current != plan.eval_token:
+                raise StaleEvalTokenError(
+                    f"plan for eval {plan.eval_id} has a stale token"
+                )
+        store = self.server.store
+        with store._lock:
+            result, index = self._apply_locked(plan)
+        if index:
+            self.server.on_plan_applied(plan, result, index)
+        return result
+
+    def _apply_locked(self, plan: Plan):
+        failed_nodes = self._evaluate(plan)
+        committed_allocs: Dict[str, List[Allocation]] = {
+            nid: allocs
+            for nid, allocs in plan.node_allocation.items()
+            if nid not in failed_nodes
+        }
+
+        allocs = [a for lst in committed_allocs.values() for a in lst]
+        allocs.extend(plan.alloc_updates)
+        stops = [a for lst in plan.node_update.values() for a in lst]
+        preempts = [
+            a
+            for nid, lst in plan.node_preemptions.items()
+            if nid not in failed_nodes
+            for a in lst
+        ]
+
+        result = PlanResult(
+            node_allocation=committed_allocs,
+            node_update=dict(plan.node_update),
+            node_preemptions={
+                nid: lst
+                for nid, lst in plan.node_preemptions.items()
+                if nid not in failed_nodes
+            },
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+        )
+
+        if not allocs and not stops and not preempts and plan.deployment is None \
+                and not plan.deployment_updates:
+            # Entirely rejected plan: nothing commits; scheduler refreshes.
+            result.refresh_index = self.server.store.latest_index
+            self.plans_partial += 1
+            return result, 0
+
+        index = self.server.next_index()
+        self.server.store.upsert_plan_results(
+            index,
+            allocs,
+            stops,
+            preempts,
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+        )
+        result.alloc_index = index
+        if failed_nodes:
+            # Partial commit ⇒ RefreshIndex so the worker re-snapshots past
+            # this apply (plan_apply.go:166-178).
+            result.refresh_index = index
+            self.plans_partial += 1
+        self.plans_applied += 1
+        return result, index
+
+    # ------------------------------------------------------------------
+
+    def _evaluate(self, plan: Plan) -> set:
+        """Return the set of node ids whose placements do NOT fit current
+        state. One vectorized kernel call for the resource check; host-side
+        checks for node existence/status and device counts."""
+        store = self.server.store
+        matrix = store.matrix
+        failed: set = set()
+
+        node_ids = list(plan.node_allocation.keys())
+        if not node_ids:
+            return failed
+
+        rows: List[int] = []
+        deltas: List[np.ndarray] = []
+        checked: List[str] = []
+        elig_required: List[bool] = []
+        for nid in node_ids:
+            node = store.nodes.get(nid)
+            # Host checks mirroring evaluateNodePlan (plan_apply.go:644-653):
+            # node must exist and be schedulable for new placements.
+            if node is None or node.status == NodeStatus.DOWN.value:
+                failed.add(nid)
+                continue
+            has_new = any(
+                a.id not in store.allocs for a in plan.node_allocation[nid]
+            )
+            if not node.ready() and has_new:
+                failed.add(nid)
+                continue
+
+            row = matrix.row_of.get(nid)
+            if row is None:
+                failed.add(nid)
+                continue
+
+            delta = np.zeros(3, np.float32)
+            dev_delta: Dict[str, int] = {}
+            for a in plan.node_allocation[nid]:
+                r = a.resources
+                delta += (r.cpu, r.memory_mb, r.disk_mb)
+                for d in r.devices:
+                    dev_delta[d.name] = dev_delta.get(d.name, 0) + d.count
+                prev = store.allocs.get(a.id)
+                if prev is not None and not prev.terminal_status() \
+                        and prev.node_id == nid:
+                    # In-place update: its old usage is already in `used`.
+                    pr = prev.resources
+                    delta -= (pr.cpu, pr.memory_mb, pr.disk_mb)
+                    for d in pr.devices:
+                        dev_delta[d.name] = dev_delta.get(d.name, 0) - d.count
+            for a in plan.node_update.get(nid, []) + plan.node_preemptions.get(
+                nid, []
+            ):
+                prev = store.allocs.get(a.id)
+                if prev is not None and not prev.terminal_status():
+                    pr = prev.resources
+                    delta -= (pr.cpu, pr.memory_mb, pr.disk_mb)
+                    for d in pr.devices:
+                        dev_delta[d.name] = dev_delta.get(d.name, 0) - d.count
+
+            # Device-count re-check stays host-side (few nodes carry asks).
+            if dev_delta:
+                host = matrix.snapshot_host()
+                for name, cnt in dev_delta.items():
+                    slot = matrix.devices.lookup(name)
+                    if slot is None:
+                        if cnt > 0:
+                            failed.add(nid)
+                        continue
+                    if host["dev_used"][row, slot] + cnt > host["dev_total"][row, slot]:
+                        failed.add(nid)
+            if nid in failed:
+                continue
+
+            rows.append(row)
+            deltas.append(delta)
+            checked.append(nid)
+            # Only new placements need the node eligible; in-place updates on
+            # a draining/ineligible node are legitimate (evaluateNodePlan
+            # only gates placements).
+            elig_required.append(has_new)
+
+        if not checked:
+            return failed
+
+        # Pad to a bucketed length so the jit cache stays warm across plans
+        # of different sizes (p99 budget: no recompiles on the hot path).
+        k = len(rows)
+        padded = 8
+        while padded < k:
+            padded *= 2
+        rows_arr = np.full(padded, -1, np.int32)
+        rows_arr[:k] = rows
+        deltas_arr = np.zeros((padded, 3), np.float32)
+        deltas_arr[:k] = np.stack(deltas)
+        elig_arr = np.zeros(padded, bool)
+        elig_arr[:k] = elig_required
+
+        from ..state.matrix import DEVICE_LOCK
+
+        with DEVICE_LOCK:
+            arrays = matrix.sync()
+            verdicts = np.asarray(
+                verify_plan_fit(arrays, rows_arr, deltas_arr, elig_arr)
+            )
+        for nid, ok in zip(checked, verdicts[:k]):
+            if not bool(ok):
+                failed.add(nid)
+        return failed

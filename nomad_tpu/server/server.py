@@ -1,0 +1,486 @@
+"""The server — single-process control plane wiring.
+
+Reference: ``nomad/server.go`` (Server struct :95-257) + the leader services
+lifecycle (``nomad/leader.go:222`` establishLeadership). This build runs a
+single authoritative server (the replicated-log seam is the ``apply_*``
+methods — every mutation funnels through them with a monotonically assigned
+index, exactly where a Raft log would slot in; see SURVEY.md §7 step 6).
+
+Wired subsystems: state store + device matrix, eval broker, blocked evals,
+plan queue + serialized applier, N scheduling workers, node heartbeat TTLs,
+and the leader reapers (failed evals, duplicate blocked evals).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+from ..state.matrix import NodeMatrix, computed_class_key, node_attributes
+from ..state.store import StateStore
+from ..structs.types import (
+    AllocClientStatus,
+    Allocation,
+    EvalStatus,
+    EvalTrigger,
+    Evaluation,
+    Job,
+    JobStatus,
+    JobType,
+    Node,
+    NodeStatus,
+    SchedulerConfiguration,
+)
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .heartbeat import HeartbeatManager
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .worker import Worker
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ServerConfig:
+    num_workers: int = 2
+    eval_nack_timeout: float = 120.0
+    eval_delivery_limit: int = 3
+    heartbeat_min_ttl: float = 10.0
+    heartbeat_max_ttl: float = 20.0
+    failed_eval_unblock_delay: float = 60.0
+    node_capacity: int = 1024
+    scheduler_config: SchedulerConfiguration = field(
+        default_factory=SchedulerConfiguration
+    )
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+        self.matrix = NodeMatrix(capacity=self.config.node_capacity)
+        self.store = StateStore(matrix=self.matrix)
+        self.store.scheduler_config = self.config.scheduler_config
+
+        self.eval_broker = EvalBroker(
+            nack_timeout=self.config.eval_nack_timeout,
+            delivery_limit=self.config.eval_delivery_limit,
+        )
+        self.blocked_evals = BlockedEvals(self.eval_broker.enqueue)
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(self)
+        self.workers: List[Worker] = [
+            Worker(self) for _ in range(self.config.num_workers)
+        ]
+        self.heartbeater = HeartbeatManager(
+            self._on_heartbeat_expired,
+            min_ttl=self.config.heartbeat_min_ttl,
+            max_ttl=self.config.heartbeat_max_ttl,
+        )
+
+        self._index_lock = threading.Lock()
+        self._index = 0
+        self._leader = False
+        self._reaper: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+
+    # ------------------------------------------------------------------
+    # Log index — the Raft seam. Every mutation gets a unique, monotonic
+    # index here; a replicated log would assign these instead.
+    # ------------------------------------------------------------------
+
+    def next_index(self) -> int:
+        with self._index_lock:
+            self._index = max(self._index, self.store.latest_index) + 1
+            return self._index
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.establish_leadership()
+
+    def establish_leadership(self) -> None:
+        """Enable leader-only services (leader.go:222)."""
+        if self._leader:
+            return
+        self._leader = True
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self.heartbeater.set_enabled(True)
+        self.plan_applier.start()
+        for w in self.workers:
+            w.start()
+        self._restore_evals()
+        # Arm TTL timers for nodes already in state — a node that died while
+        # no leader was watching must still expire (initializeHeartbeatTimers,
+        # nomad/heartbeat.go:21).
+        for node in list(self.store.nodes.values()):
+            if node.status != NodeStatus.DOWN.value:
+                self.heartbeater.reset_heartbeat(node.id)
+        self._shutdown.clear()
+        self._reaper = threading.Thread(
+            target=self._run_reapers, name="leader-reapers", daemon=True
+        )
+        self._reaper.start()
+
+    def revoke_leadership(self) -> None:
+        if not self._leader:
+            return
+        self._leader = False
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        self.heartbeater.set_enabled(False)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._leader = False
+        for w in self.workers:
+            w.stop()
+        self.plan_applier.stop()
+        self.eval_broker.shutdown()
+        self.plan_queue.shutdown()
+        self.heartbeater.set_enabled(False)
+
+    def _restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals from state on leadership gain
+        (restoreEvals, leader.go:493)."""
+        for ev in list(self.store.evals.values()):
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    # ------------------------------------------------------------------
+    # Job RPCs (nomad/job_endpoint.go:80 Register, :797 Deregister)
+    # ------------------------------------------------------------------
+
+    def submit_job(self, job: Job) -> Optional[Evaluation]:
+        index = self.next_index()
+        job.submit_time = time.time()
+        job.status = JobStatus.PENDING.value
+        self.store.upsert_job(index, job)
+
+        if job.is_periodic() or job.is_parameterized():
+            # Periodic/parameterized jobs get no eval at register time —
+            # children are dispatched later (job_endpoint.go:245-260).
+            return None
+
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EvalTrigger.JOB_REGISTER.value,
+            job_id=job.id,
+            job_modify_index=index,
+            status=EvalStatus.PENDING.value,
+        )
+        self.apply_eval_updates([ev])
+        return ev
+
+    def deregister_job(
+        self, namespace: str, job_id: str, purge: bool = False
+    ) -> Optional[Evaluation]:
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        index = self.next_index()
+        if purge:
+            self.store.delete_job(index, namespace, job_id)
+        else:
+            stopped = job.copy()
+            stopped.stop = True
+            self.store.upsert_job(index, stopped)
+        self.blocked_evals.untrack(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EvalTrigger.JOB_DEREGISTER.value,
+            job_id=job_id,
+            status=EvalStatus.PENDING.value,
+        )
+        self.apply_eval_updates([ev])
+        return ev
+
+    # ------------------------------------------------------------------
+    # Eval apply (fsm.go applyUpdateEval → broker/blocked routing)
+    # ------------------------------------------------------------------
+
+    def apply_eval_updates(self, evals: List[Evaluation]) -> int:
+        index = self.next_index()
+        self.store.upsert_evals(index, evals)
+        for ev in evals:
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+        return index
+
+    # ------------------------------------------------------------------
+    # Node RPCs (nomad/node_endpoint.go:80 Register, :375 UpdateStatus,
+    # :511 UpdateDrain, :1054 UpdateAlloc)
+    # ------------------------------------------------------------------
+
+    def register_node(self, node: Node) -> float:
+        prev = self.store.node_by_id(node.id)
+        index = self.next_index()
+        self.store.upsert_node(index, node)
+        ttl = self.heartbeater.reset_heartbeat(node.id)
+        new_capacity = prev is None or prev.terminal() or not prev.ready()
+        if new_capacity and node.ready():
+            self._capacity_added(node, index)
+            self._create_node_evals(node, index, system_only=True)
+        return ttl
+
+    def heartbeat_node(self, node_id: str) -> float:
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            return 0.0
+        if node.status == NodeStatus.DOWN.value:
+            # A heartbeat from a down node re-registers it as initializing
+            # until the client pushes a full update (node_endpoint.go:476).
+            self.update_node_status(node_id, NodeStatus.INIT.value)
+        return self.heartbeater.reset_heartbeat(node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        node = self.store.node_by_id(node_id)
+        if node is None:
+            return
+        transitioned_down = (
+            status == NodeStatus.DOWN.value and node.status != NodeStatus.DOWN.value
+        )
+        became_ready = (
+            status == NodeStatus.READY.value and node.status != NodeStatus.READY.value
+        )
+        index = self.next_index()
+        self.store.update_node_status(index, node_id, status)
+        node = self.store.node_by_id(node_id)
+        if transitioned_down:
+            self.heartbeater.clear_heartbeat(node_id)
+            self._create_node_evals(node, index)
+        elif became_ready and node.ready():
+            self._capacity_added(node, index)
+            # init→ready also needs node evals so system jobs land on the
+            # node (UpdateStatus → createNodeEvals, node_endpoint.go:375).
+            self._create_node_evals(node, index, system_only=True)
+
+    def update_node_drain(
+        self, node_id: str, drain_strategy, mark_eligible: bool = False
+    ) -> None:
+        index = self.next_index()
+        self.store.update_node_drain(index, node_id, drain_strategy, mark_eligible)
+        node = self.store.node_by_id(node_id)
+        if node is not None:
+            if node.drain:
+                self._create_node_evals(node, index)
+            elif node.ready():
+                self._capacity_added(node, index)
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> None:
+        index = self.next_index()
+        self.store.update_node_eligibility(index, node_id, eligibility)
+        node = self.store.node_by_id(node_id)
+        if node is not None and node.ready():
+            self._capacity_added(node, index)
+
+    def _on_heartbeat_expired(self, node_id: str) -> None:
+        log.info("node %s missed heartbeat, marking down", node_id)
+        self.update_node_status(node_id, NodeStatus.DOWN.value)
+
+    def _capacity_added(self, node: Node, index: int) -> None:
+        cls = computed_class_key(node_attributes(node), node)
+        self.blocked_evals.unblock(cls, index)
+        self.blocked_evals.unblock_node(node.id, index)
+
+    def _create_node_evals(
+        self, node: Node, index: int, system_only: bool = False
+    ) -> None:
+        """One eval per job touching the node (+ system jobs in its DC) —
+        createNodeEvals (node_endpoint.go:1145)."""
+        if node is None:
+            return
+        evals: List[Evaluation] = []
+        jobs_seen = set()
+        if not system_only:
+            for alloc in self.store.allocs_by_node(node.id):
+                if alloc.terminal_status():
+                    continue
+                key = (alloc.namespace, alloc.job_id)
+                if key in jobs_seen:
+                    continue
+                jobs_seen.add(key)
+                job = self.store.job_by_id(*key)
+                if job is None:
+                    continue
+                evals.append(
+                    Evaluation(
+                        namespace=alloc.namespace,
+                        priority=job.priority,
+                        type=job.type,
+                        triggered_by=EvalTrigger.NODE_UPDATE.value,
+                        job_id=alloc.job_id,
+                        node_id=node.id,
+                        node_modify_index=index,
+                        status=EvalStatus.PENDING.value,
+                    )
+                )
+        for job in self.store.all_jobs():
+            if job.type != JobType.SYSTEM.value or job.stopped():
+                continue
+            if node.datacenter not in job.datacenters:
+                continue
+            if (job.namespace, job.id) in jobs_seen:
+                continue
+            evals.append(
+                Evaluation(
+                    namespace=job.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=EvalTrigger.NODE_UPDATE.value,
+                    job_id=job.id,
+                    node_id=node.id,
+                    node_modify_index=index,
+                    status=EvalStatus.PENDING.value,
+                )
+            )
+        if evals:
+            self.apply_eval_updates(evals)
+
+    # ------------------------------------------------------------------
+    # Alloc client updates (Node.UpdateAlloc, node_endpoint.go:1054)
+    # ------------------------------------------------------------------
+
+    def update_allocs_from_client(self, updates: List[Allocation]) -> None:
+        index = self.next_index()
+        evals: List[Evaluation] = []
+        freed_nodes: Dict[str, Node] = {}
+        jobs_seen = set()
+        for upd in updates:
+            prev = self.store.alloc_by_id(upd.id)
+            if prev is None:
+                continue
+            became_terminal = not prev.client_terminal() and upd.client_status in (
+                AllocClientStatus.COMPLETE.value,
+                AllocClientStatus.FAILED.value,
+                AllocClientStatus.LOST.value,
+            )
+            if became_terminal:
+                node = self.store.node_by_id(prev.node_id)
+                if node is not None:
+                    freed_nodes[node.id] = node
+            # Failed alloc → reschedule eval (node_endpoint.go:1079-1107).
+            if (
+                upd.client_status == AllocClientStatus.FAILED.value
+                and prev.client_status != AllocClientStatus.FAILED.value
+            ):
+                key = (prev.namespace, prev.job_id)
+                job = self.store.job_by_id(*key)
+                if job is not None and not job.stopped() and key not in jobs_seen:
+                    jobs_seen.add(key)
+                    evals.append(
+                        Evaluation(
+                            namespace=prev.namespace,
+                            priority=job.priority,
+                            type=job.type,
+                            triggered_by=EvalTrigger.RETRY_FAILED_ALLOC.value,
+                            job_id=prev.job_id,
+                            status=EvalStatus.PENDING.value,
+                        )
+                    )
+        self.store.update_allocs_from_client(index, updates)
+        for node in freed_nodes.values():
+            self._capacity_added(node, index)
+        if evals:
+            self.apply_eval_updates(evals)
+
+    def stop_alloc(self, alloc_id: str) -> Optional[Evaluation]:
+        """User-initiated ``alloc stop`` (alloc_endpoint.go Stop): set the
+        desired transition and create a reschedule eval."""
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None
+        index = self.next_index()
+        stopped = alloc.copy()
+        stopped.desired_transition.reschedule = True
+        ev = Evaluation(
+            namespace=alloc.namespace,
+            priority=alloc.job_priority(),
+            type=alloc.job.type if alloc.job else JobType.SERVICE.value,
+            triggered_by=EvalTrigger.ALLOC_STOP.value,
+            job_id=alloc.job_id,
+            status=EvalStatus.PENDING.value,
+        )
+        self.store.upsert_allocs(index, [stopped])
+        self.apply_eval_updates([ev])
+        return ev
+
+    # ------------------------------------------------------------------
+    # Plan-apply hook
+    # ------------------------------------------------------------------
+
+    def on_plan_applied(self, plan, result, index: int) -> None:
+        """Post-commit: stopped/preempted allocs free capacity → unblock
+        their nodes' classes (the watchCapacity feed, blocked_evals.go:508)."""
+        freed = set(result.node_update.keys()) | set(result.node_preemptions.keys())
+        for nid in freed:
+            node = self.store.node_by_id(nid)
+            if node is not None:
+                cls = computed_class_key(node_attributes(node), node)
+                self.blocked_evals.unblock(cls, index)
+
+    # ------------------------------------------------------------------
+    # Leader reapers
+    # ------------------------------------------------------------------
+
+    def _run_reapers(self) -> None:
+        """Failed-eval reaper + duplicate-blocked-eval reaper
+        (leader.go:556 reapFailedEvaluations, :593 reapDupBlockedEvaluations)."""
+        while not self._shutdown.is_set():
+            for ev in self.eval_broker.failed_evals():
+                failed = ev.copy()
+                failed.status = EvalStatus.FAILED.value
+                failed.status_description = (
+                    "maximum attempts reached (%d)" % self.eval_broker.delivery_limit
+                )
+                # Follow-up eval retries the job later with a delay
+                # (leader.go:573-585).
+                followup = Evaluation(
+                    namespace=ev.namespace,
+                    priority=ev.priority,
+                    type=ev.type,
+                    triggered_by=EvalTrigger.FAILED_FOLLOW_UP.value,
+                    job_id=ev.job_id,
+                    status=EvalStatus.PENDING.value,
+                    wait_until=time.time() + self.config.failed_eval_unblock_delay,
+                )
+                index = self.next_index()
+                self.store.upsert_evals(index, [failed, followup])
+                self.eval_broker.enqueue(followup)
+            for dup in self.blocked_evals.duplicates():
+                cancelled = dup.copy()
+                cancelled.status = EvalStatus.CANCELLED.value
+                self.store.upsert_evals(self.next_index(), [cancelled])
+            self._shutdown.wait(0.5)
+
+    # ------------------------------------------------------------------
+    # Introspection helpers
+    # ------------------------------------------------------------------
+
+    def wait_for_eval(
+        self, eval_id: str, timeout: float = 10.0
+    ) -> Optional[Evaluation]:
+        """Poll until the eval reaches a terminal status (test/CLI helper)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ev = self.store.eval_by_id(eval_id)
+            if ev is not None and ev.terminal_status():
+                return ev
+            time.sleep(0.01)
+        return self.store.eval_by_id(eval_id)

@@ -1,0 +1,127 @@
+"""Scheduling worker — dequeue → snapshot-sync → schedule → submit → ack.
+
+Reference: ``nomad/worker.go`` (``Worker.run`` :105-138). Each worker is a
+thread that pulls evaluations from the broker, waits for its local state to
+catch up to the eval's index (``snapshotMinIndex``, :228 — the ★sync point),
+invokes the right scheduler, and acks/nacks the eval. The worker itself is
+the scheduler's ``Planner``: ``submit_plan`` enqueues on the leader's plan
+queue and blocks on the apply future, then waits out any refresh index
+before handing the scheduler a fresh snapshot (:277-330).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Tuple
+
+from ..scheduler import new_scheduler
+from ..state.store import StateSnapshot
+from ..structs.types import Evaluation, Plan, PlanResult
+
+log = logging.getLogger(__name__)
+
+# Scheduler types a worker serves (reference: config.EnabledSchedulers).
+DEFAULT_SCHEDULERS = ["service", "batch", "system", "_core"]
+
+# Backstop so a wedged applier can't deadlock a worker forever.
+PLAN_APPLY_TIMEOUT = 60.0
+
+
+class Worker:
+    def __init__(self, server, schedulers: Optional[List[str]] = None):
+        self.server = server
+        self.schedulers = schedulers or list(DEFAULT_SCHEDULERS)
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.evals_processed = 0
+        self._snapshot: Optional[StateSnapshot] = None
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, name="worker", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def set_paused(self, paused: bool) -> None:
+        if paused:
+            self._paused.set()
+        else:
+            self._paused.clear()
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                self._stop.wait(0.05)
+                continue
+            ev, token = self.server.eval_broker.dequeue(
+                self.schedulers, timeout=0.2
+            )
+            if ev is None:
+                continue
+            try:
+                self.process_eval(ev, token)
+            except Exception:  # noqa: BLE001
+                log.exception("scheduler failed for eval %s", ev.id)
+                try:
+                    self.server.eval_broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+                continue
+            try:
+                self.server.eval_broker.ack(ev.id, token)
+            except ValueError:
+                pass
+            self.evals_processed += 1
+
+    def process_eval(self, ev: Evaluation, token: str = "") -> None:
+        # The delivery token rides on the eval; schedulers stamp it into
+        # their plans so the applier can reject a worker whose delivery was
+        # nack-timeout-redelivered mid-schedule (eval_token, worker.go:74).
+        ev.leader_ack = token
+        # ★ sync point: local replica must reach the eval's creation index
+        # before scheduling (worker.go:121, snapshotMinIndex).
+        self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
+        self._snapshot = self.server.store.snapshot()
+        sched = new_scheduler(
+            ev.type, self._snapshot, self, self.server.store.matrix
+        )
+        sched.process(ev)
+
+    # ------------------------------------------------------------------
+    # Planner interface (scheduler/scheduler.go:112; worker.go:277-330)
+    # ------------------------------------------------------------------
+
+    def submit_plan(
+        self, plan: Plan
+    ) -> Tuple[Optional[PlanResult], Optional[StateSnapshot]]:
+        pending = self.server.plan_queue.enqueue(plan)
+        try:
+            result = pending.wait(timeout=PLAN_APPLY_TIMEOUT)
+        except Exception:  # noqa: BLE001 — queue disabled / apply error
+            return None, self.server.store.snapshot()
+        snapshot = None
+        if result.refresh_index:
+            # Partial commit: catch up to the refresh index before retrying
+            # (worker.go SubmitPlan → snapshotMinIndex(RefreshIndex)).
+            self.server.store.wait_for_index(result.refresh_index, timeout=5.0)
+            snapshot = self.server.store.snapshot()
+        return result, snapshot
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.apply_eval_updates([ev])
+
+    def create_evals(self, evals: List[Evaluation]) -> None:
+        self.server.apply_eval_updates(list(evals))
+
+    def refresh_snapshot(self) -> StateSnapshot:
+        return self.server.store.snapshot()

@@ -35,12 +35,21 @@ host↔device transfer per plan).
 from __future__ import annotations
 
 import math
+import threading
 import zlib
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..structs.types import Allocation, Node
+
+# All device interactions funnel through this lock. There is one chip per
+# scheduler process, so serializing kernel dispatch costs nothing — and the
+# experimental single-chip TPU client deadlocks under concurrent host
+# threads (observed: a worker's host→device transfer in sync() wedging while
+# a second worker dispatched a kernel). Reentrant so sync() nests inside a
+# locked select().
+DEVICE_LOCK = threading.RLock()
 
 # Fixed encoding widths. Attribute slots beyond ATTR_SLOTS fall back to
 # host-side per-class evaluation (the reference's own escape hatch).
@@ -421,6 +430,10 @@ class NodeMatrix:
         Full upload on first use or growth; per-row scatter otherwise
         (`.at[rows].set`) so steady-state transfer is O(dirty rows).
         """
+        with DEVICE_LOCK:
+            return self._sync_locked()
+
+    def _sync_locked(self) -> DeviceArrays:
         import jax.numpy as jnp
 
         if self._device is None or not self._device_valid:

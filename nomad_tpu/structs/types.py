@@ -752,6 +752,15 @@ class Evaluation:
         if not self.create_time:
             self.create_time = time.time()
 
+    def copy(self) -> "Evaluation":
+        """Copy with fresh mutable containers (no dict aliasing between the
+        copy and the original)."""
+        new = Evaluation(**self.__dict__)
+        new.class_eligibility = dict(self.class_eligibility)
+        new.queued_allocations = dict(self.queued_allocations)
+        new.failed_tg_allocs = dict(self.failed_tg_allocs)
+        return new
+
     def terminal_status(self) -> bool:
         return self.status in (
             EvalStatus.COMPLETE.value,

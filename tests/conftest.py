@@ -8,7 +8,26 @@ This must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the environment preset JAX_PLATFORMS (e.g. the real TPU
+# tunnel): unit tests validate logic + sharding on the virtual mesh; only
+# bench.py runs on the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Drop any registered TPU-tunnel backend factory: with the plugin registered,
+# jax initializes it even under JAX_PLATFORMS=cpu, and a wedged tunnel then
+# hangs every test (observed: make_c_api_client blocking forever).
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # sitecustomize imports jax before this file runs, so the env var alone
+    # is too late — update the live config too.
+    jax.config.update("jax_platforms", "cpu")
+    for _name in list(_xb._backend_factories):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +35,11 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import pytest  # noqa: E402
+
+import nomad_tpu  # noqa: E402
+
+# Kernel first-compiles are tens of seconds; persist them across test runs.
+nomad_tpu.enable_compilation_cache("/root/repo/.jax_cache")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,396 @@
+"""Server-core tests: eval broker, blocked evals, plan applier, and the
+end-to-end single-process server slice (tier 2 of SURVEY.md §4 — in-process
+integration with real workers and the serialized applier)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import EvalBroker, Server, ServerConfig
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import FAILED_QUEUE
+from nomad_tpu.structs.types import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Allocation,
+    EvalStatus,
+    Evaluation,
+    NodeStatus,
+    Plan,
+    Resources,
+)
+
+
+# ---------------------------------------------------------------------------
+# EvalBroker
+# ---------------------------------------------------------------------------
+
+
+class TestEvalBroker:
+    def _broker(self, **kw):
+        b = EvalBroker(**kw)
+        b.set_enabled(True)
+        return b
+
+    def test_priority_order(self):
+        b = self._broker()
+        lo = Evaluation(priority=20, type="service", job_id="a")
+        hi = Evaluation(priority=80, type="service", job_id="b")
+        b.enqueue(lo)
+        b.enqueue(hi)
+        ev, tok = b.dequeue(["service"], timeout=1)
+        assert ev.id == hi.id
+        b.ack(ev.id, tok)
+        ev2, tok2 = b.dequeue(["service"], timeout=1)
+        assert ev2.id == lo.id
+        b.ack(ev2.id, tok2)
+
+    def test_scheduler_type_queues(self):
+        b = self._broker()
+        svc = Evaluation(type="service", job_id="a")
+        sys_ = Evaluation(type="system", job_id="b")
+        b.enqueue(svc)
+        b.enqueue(sys_)
+        ev, tok = b.dequeue(["system"], timeout=1)
+        assert ev.id == sys_.id
+        b.ack(ev.id, tok)
+        assert b.ready_count("service") == 1
+
+    def test_ack_token_mismatch(self):
+        b = self._broker()
+        ev = Evaluation(type="service", job_id="a")
+        b.enqueue(ev)
+        got, _tok = b.dequeue(["service"], timeout=1)
+        with pytest.raises(ValueError):
+            b.ack(got.id, "bogus")
+
+    def test_nack_redelivers_then_fails(self):
+        b = self._broker(delivery_limit=2)
+        ev = Evaluation(type="service", job_id="a")
+        b.enqueue(ev)
+        for _ in range(2):
+            got, tok = b.dequeue(["service"], timeout=1)
+            assert got.id == ev.id
+            b.nack(got.id, tok)
+        # Past the delivery limit → failed queue, not redelivered.
+        got, _ = b.dequeue(["service"], timeout=0.2)
+        assert got is None
+        failed = b.failed_evals()
+        assert [e.id for e in failed] == [ev.id]
+
+    def test_per_job_serialization(self):
+        b = self._broker()
+        first = Evaluation(type="service", job_id="job1", priority=50)
+        second = Evaluation(type="service", job_id="job1", priority=90)
+        b.enqueue(first)
+        b.enqueue(second)  # parked: same job already ready
+        got, tok = b.dequeue(["service"], timeout=1)
+        assert got.id == first.id
+        none, _ = b.dequeue(["service"], timeout=0.1)
+        assert none is None  # second is parked until first acks
+        assert b.pending_count() == 1
+        b.ack(first.id, tok)
+        got2, tok2 = b.dequeue(["service"], timeout=1)
+        assert got2.id == second.id
+        b.ack(got2.id, tok2)
+
+    def test_delayed_eval(self):
+        b = self._broker()
+        ev = Evaluation(type="service", job_id="a", wait_until=time.time() + 0.3)
+        b.enqueue(ev)
+        got, _ = b.dequeue(["service"], timeout=0.1)
+        assert got is None
+        assert b.delayed_count() == 1
+        got, tok = b.dequeue(["service"], timeout=2)
+        assert got is not None and got.id == ev.id
+        b.ack(got.id, tok)
+
+    def test_nack_timeout_requeues(self):
+        b = self._broker(nack_timeout=0.2)
+        ev = Evaluation(type="service", job_id="a")
+        b.enqueue(ev)
+        got, _tok = b.dequeue(["service"], timeout=1)
+        assert got.id == ev.id
+        # Never ack; the sweep should redeliver after the timeout.
+        got2, tok2 = b.dequeue(["service"], timeout=3)
+        assert got2 is not None and got2.id == ev.id
+        b.ack(got2.id, tok2)
+
+    def test_disabled_defers(self):
+        b = EvalBroker()
+        ev = Evaluation(type="service", job_id="a")
+        b.enqueue(ev)
+        assert b.ready_count() == 0
+        b.set_enabled(True)
+        got, tok = b.dequeue(["service"], timeout=1)
+        assert got.id == ev.id
+        b.ack(got.id, tok)
+
+
+# ---------------------------------------------------------------------------
+# BlockedEvals
+# ---------------------------------------------------------------------------
+
+
+class TestBlockedEvals:
+    def _pair(self):
+        out = []
+        be = BlockedEvals(out.append)
+        be.set_enabled(True)
+        return be, out
+
+    def test_block_unblock_class(self):
+        be, out = self._pair()
+        ev = Evaluation(job_id="j1", snapshot_index=10)
+        ev.status = EvalStatus.BLOCKED.value
+        ev.class_eligibility = {"c1": False}
+        be.block(ev)
+        be.unblock("c1", index=11)  # already known-ineligible → stays
+        assert not out and be.blocked_count() == 1
+        be.unblock("c2", index=12)  # unseen class → retry
+        assert [e.id for e in out] == [ev.id]
+        assert out[0].status == EvalStatus.PENDING.value
+        assert be.blocked_count() == 0
+
+    def test_escaped_unblocks_on_any_change(self):
+        be, out = self._pair()
+        ev = Evaluation(job_id="j1", escaped_computed_class=True)
+        be.block(ev)
+        be.unblock("anything", index=5)
+        assert [e.id for e in out] == [ev.id]
+
+    def test_missed_unblock(self):
+        be, out = self._pair()
+        be.unblock("c9", index=100)
+        ev = Evaluation(job_id="j1", snapshot_index=50)  # older than unblock
+        be.block(ev)
+        assert [e.id for e in out] == [ev.id]  # immediately retried
+
+    def test_duplicates_tracked(self):
+        be, out = self._pair()
+        a = Evaluation(job_id="j1", namespace="default")
+        b = Evaluation(job_id="j1", namespace="default")
+        be.block(a)
+        be.block(b)
+        dups = be.duplicates()
+        assert [d.id for d in dups] == [a.id]
+        assert be.blocked_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end server slice
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _wait(pred, timeout=10.0, every=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+class TestServerEndToEnd:
+    def test_job_register_places_allocs(self, server):
+        for _ in range(4):
+            server.register_node(mock.node())
+        job = mock.job()  # 10 allocs of 500MHz/256MB over 4×(3900MHz, ~8GB)
+        ev = server.submit_job(job)
+        done = server.wait_for_eval(ev.id, timeout=90)
+        assert done is not None and done.status == EvalStatus.COMPLETE.value
+        allocs = server.store.allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 10
+        assert all(a.node_id for a in allocs)
+
+    def test_placement_failure_blocks_then_unblocks(self, server):
+        # One node: fits a single 3000MHz ask (3900 available), not two.
+        server.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].resources = Resources(cpu=3000, memory_mb=512)
+        ev = server.submit_job(job)
+        done = server.wait_for_eval(ev.id, timeout=90)
+        assert done.status == EvalStatus.COMPLETE.value
+        # One placed, one blocked.
+        assert _wait(
+            lambda: len(
+                [
+                    a
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()
+                ]
+            )
+            == 1
+        )
+        assert _wait(lambda: server.blocked_evals.blocked_count() == 1, timeout=10)
+
+        # New capacity arrives → blocked eval retries → second alloc places.
+        server.register_node(mock.node())
+        assert _wait(
+            lambda: len(
+                [
+                    a
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()
+                ]
+            )
+            == 2,
+            timeout=90,
+        )
+
+    def test_deregister_stops_allocs(self, server):
+        server.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        ev = server.submit_job(job)
+        server.wait_for_eval(ev.id, timeout=90)
+        ev2 = server.deregister_job(job.namespace, job.id)
+        server.wait_for_eval(ev2.id, timeout=90)
+        assert _wait(
+            lambda: all(
+                a.desired_status != AllocDesiredStatus.RUN.value
+                for a in server.store.allocs_by_job(job.namespace, job.id)
+            )
+        )
+
+    def test_node_down_reschedules(self, server):
+        n1 = mock.node()
+        n2 = mock.node()
+        server.register_node(n1)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        ev = server.submit_job(job)
+        server.wait_for_eval(ev.id, timeout=90)
+        allocs = server.store.allocs_by_job(job.namespace, job.id)
+        assert all(a.node_id == n1.id for a in allocs)
+
+        server.register_node(n2)
+        server.update_node_status(n1.id, NodeStatus.DOWN.value)
+        # Lost allocs replaced onto n2.
+        assert _wait(
+            lambda: len(
+                [
+                    a
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status() and a.node_id == n2.id
+                ]
+            )
+            == 2,
+            timeout=90,
+        )
+
+    def test_system_job_runs_on_new_nodes(self, server):
+        server.register_node(mock.node())
+        job = mock.system_job()
+        ev = server.submit_job(job)
+        server.wait_for_eval(ev.id, timeout=90)
+        assert len(server.store.allocs_by_job(job.namespace, job.id)) == 1
+        # A later node gets the system job via node-update eval.
+        server.register_node(mock.node())
+        assert _wait(
+            lambda: len(
+                [
+                    a
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()
+                ]
+            )
+            == 2,
+            timeout=90,
+        )
+
+    def test_failed_alloc_triggers_reschedule_eval(self, server):
+        server.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        ev = server.submit_job(job)
+        server.wait_for_eval(ev.id, timeout=90)
+        alloc = server.store.allocs_by_job(job.namespace, job.id)[0]
+
+        upd = alloc.copy()
+        upd.client_status = AllocClientStatus.FAILED.value
+        server.update_allocs_from_client([upd])
+        # Reschedule: a replacement alloc appears (reconciler reschedules
+        # failed service allocs; default policy is unlimited w/ 30s delay,
+        # so accept either an immediate replacement or a follow-up eval).
+        assert _wait(
+            lambda: any(
+                e.triggered_by == "retry-failed-alloc"
+                for e in server.store.evals_by_job(job.namespace, job.id)
+            ),
+            timeout=10,
+        )
+
+
+class TestPlanApplierConflict:
+    def test_stale_eval_token_rejected(self, server):
+        """A worker whose eval delivery was redelivered (nack timeout) must
+        not commit its plan (reference: plan_apply.go eval-token check)."""
+        from nomad_tpu.server.plan_apply import StaleEvalTokenError
+
+        node = mock.node()
+        server.register_node(node)
+        # Pause workers so we control delivery.
+        for w in server.workers:
+            w.set_paused(True)
+        ev = Evaluation(type="service", job_id="tok-job")
+        server.eval_broker.enqueue(ev)
+        got, token = server.eval_broker.dequeue(["service"], timeout=2)
+        assert got.id == ev.id
+        server.eval_broker.nack(ev.id, token)  # simulate timeout redelivery
+        got2, token2 = server.eval_broker.dequeue(["service"], timeout=2)
+        assert got2.id == ev.id and token2 != token
+
+        plan = Plan(priority=50, eval_id=ev.id, eval_token=token)  # stale
+        a = mock.alloc(n=node)
+        plan.append_alloc(a)
+        with pytest.raises(StaleEvalTokenError):
+            server.plan_applier.apply(plan)
+        assert server.store.alloc_by_id(a.id) is None
+
+        plan2 = Plan(priority=50, eval_id=ev.id, eval_token=token2)  # current
+        plan2.append_alloc(a)
+        result = server.plan_applier.apply(plan2)
+        assert list(result.node_allocation) == [node.id]
+        server.eval_broker.ack(ev.id, token2)
+        for w in server.workers:
+            w.set_paused(False)
+
+    def test_overcommit_rejected(self, server):
+        node = mock.node()
+        server.register_node(node)
+        # Fill the node almost completely out-of-band.
+        big = mock.alloc(n=node)
+        big.resources = Resources(cpu=3500, memory_mb=7000)
+        server.store.upsert_allocs(server.next_index(), [big])
+
+        plan = Plan(priority=50)
+        a = mock.alloc(n=node)
+        a.resources = Resources(cpu=1000, memory_mb=1000)
+        a.client_status = AllocClientStatus.PENDING.value
+        plan.append_alloc(a)
+        result = server.plan_applier.apply(plan)
+        assert result.node_allocation == {}  # rejected
+        assert result.refresh_index > 0
+
+    def test_fit_commits(self, server):
+        node = mock.node()
+        server.register_node(node)
+        plan = Plan(priority=50)
+        a = mock.alloc(n=node)
+        a.resources = Resources(cpu=1000, memory_mb=1000)
+        plan.append_alloc(a)
+        result = server.plan_applier.apply(plan)
+        assert list(result.node_allocation) == [node.id]
+        assert result.refresh_index == 0
+        assert server.store.alloc_by_id(a.id) is not None
