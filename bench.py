@@ -1,0 +1,158 @@
+"""C2M-scale scheduler benchmark (driver entry).
+
+Simulates the reference's headline scale — 10K nodes carrying ~2M
+allocations (BASELINE.md / SURVEY.md §6) — and measures evaluation
+throughput of the batched TPU scheduler: each eval scores EVERY node (no
+candidate sampling) and argmaxes, B evals per kernel dispatch, optimistic
+concurrency left to the plan applier exactly as in the live server.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Target (BASELINE.json): >= 50K evals/sec, p99 < 5 ms, on 1x TPU v5e.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_NODES = int(os.environ.get("BENCH_NODES", "10000"))
+CAPACITY = 10240 if N_NODES <= 10240 else 1 << (N_NODES - 1).bit_length()
+N_ALLOCS = int(os.environ.get("BENCH_ALLOCS", "2000000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+# Enough samples that p99 is a real tail statistic, not the max.
+DISPATCHES = int(os.environ.get("BENCH_DISPATCHES", "300"))
+JOB_SHAPES = 8
+
+
+def build_cluster():
+    from nomad_tpu import mock
+    from nomad_tpu.state.matrix import NodeMatrix, PRIORITY_BUCKETS
+
+    rng = np.random.default_rng(42)
+    m = NodeMatrix(capacity=CAPACITY)
+    for i in range(N_NODES):
+        node = mock.node()
+        node.datacenter = f"dc{i % 4 + 1}"
+        node.node_class = f"class-{i % 6}"
+        node.attributes = dict(node.attributes)
+        node.attributes["rack"] = f"r{i % 32}"
+        node.attributes["platform.tpu.type"] = "v5e" if i % 3 else "v5p"
+        m.upsert_node(node)
+
+    # ~N_ALLOCS allocations aggregated per node (the matrix carries usage
+    # aggregates, the same thing AllocsFit recomputes per call in the
+    # reference, funcs.go:97-150).
+    host = m.snapshot_host()
+    per_node = N_ALLOCS / N_NODES
+    # Average alloc: ~100 MHz cpu / 128 MB mem / 30 MB disk; cap at 75%.
+    usage = rng.poisson(per_node, N_NODES)[:, None] * np.array(
+        [[100.0, 128.0, 30.0]]
+    ) * rng.uniform(0.05, 0.12, (N_NODES, 1))
+    usage = np.minimum(usage, host["totals"][:N_NODES] * 0.75)
+    host["used"][:N_NODES] = usage
+    # Spread usage over priority buckets so preemption paths see real data.
+    shares = rng.dirichlet(np.ones(4), N_NODES)
+    for j, b in enumerate(rng.choice(PRIORITY_BUCKETS, 4, replace=False)):
+        host["prio_used"][:N_NODES, b] = usage * shares[:, j : j + 1]
+    m._dirty.update(range(N_NODES))
+    return m
+
+
+def build_requests(m):
+    """A mix of job shapes: plain binpack, affinity, spread, constrained."""
+    from nomad_tpu import mock
+    from nomad_tpu.ops.encode import RequestEncoder
+    from nomad_tpu.structs.types import Affinity, Constraint, Op, Spread
+
+    enc = RequestEncoder(m)
+    shapes = []
+    for i in range(JOB_SHAPES):
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.cpu = 100 + 50 * (i % 4)
+        tg.tasks[0].resources.memory_mb = 128 + 64 * (i % 3)
+        if i % 4 == 1:
+            tg.affinities = [
+                Affinity(l_target="${attr.platform.tpu.type}",
+                         r_target="v5e", operand=Op.EQ.value, weight=50)
+            ]
+        if i % 4 == 2:
+            tg.spreads = [Spread(attribute="${attr.rack}", weight=50)]
+        if i % 4 == 3:
+            tg.constraints = [
+                Constraint(l_target="${attr.kernel.name}",
+                           r_target="linux", operand=Op.EQ.value)
+            ]
+        shapes.append(enc.compile(job, tg).request)
+    return shapes
+
+
+def main() -> None:
+    t_setup = time.time()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    import nomad_tpu
+
+    nomad_tpu.enable_compilation_cache(os.path.join(repo, ".jax_cache_tpu"))
+
+    import jax
+
+    from nomad_tpu.ops.kernels import score_batch
+    from nomad_tpu.parallel import build_batch_inputs
+
+    platform = jax.devices()[0].platform
+    m = build_cluster()
+    shapes = build_requests(m)
+    arrays = m.sync()
+    inp = build_batch_inputs(
+        m, [shapes[i % JOB_SHAPES] for i in range(BATCH)]
+    )
+
+    def dispatch():
+        return score_batch(
+            arrays, arrays.used, inp["tg_counts"], inp["spread_counts"],
+            inp["penalties"], inp["reqs"], inp["class_eligs"],
+            inp["host_masks"],
+        )
+
+    # Warmup (compile + cache).
+    out = dispatch()
+    out.rows.block_until_ready()
+    placed = int((np.asarray(out.rows) >= 0).sum())
+    for _ in range(2):
+        dispatch().rows.block_until_ready()
+
+    times = []
+    t0 = time.time()
+    for _ in range(DISPATCHES):
+        t = time.time()
+        dispatch().rows.block_until_ready()
+        times.append(time.time() - t)
+    total = time.time() - t0
+
+    evals = DISPATCHES * BATCH
+    throughput = evals / total
+    arr = np.array(times)
+    p99_ms = float(np.percentile(arr, 99) * 1000.0)
+    result = {
+        "metric": "eval_throughput",
+        "value": round(throughput, 1),
+        "unit": "evals/sec",
+        "vs_baseline": round(throughput / 50000.0, 3),
+        "p99_ms": round(p99_ms, 3),
+        "max_ms": round(float(arr.max()) * 1000.0, 3),
+        "batch": BATCH,
+        "nodes": N_NODES,
+        "sim_allocs": N_ALLOCS,
+        "placed_in_first_batch": placed,
+        "platform": platform,
+        "setup_s": round(time.time() - t_setup, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

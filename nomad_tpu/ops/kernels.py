@@ -375,6 +375,71 @@ def score_nodes(
 
 
 # ---------------------------------------------------------------------------
+# Batched independent evals (the throughput path)
+# ---------------------------------------------------------------------------
+
+
+class BatchScoreResult(NamedTuple):
+    rows: jnp.ndarray  # (B,) i32 argmax node row, -1 = no fit
+    scores: jnp.ndarray  # (B,) f32
+    binpack: jnp.ndarray  # (B,) f32
+    preempted: jnp.ndarray  # (B,) bool
+    nodes_evaluated: jnp.ndarray  # (B,) i32
+    nodes_filtered: jnp.ndarray  # (B,) i32
+    nodes_exhausted: jnp.ndarray  # (B,) i32
+
+
+def _score_and_pick(arrays, used, tg_count, spread_counts, penalty, req,
+                    class_elig, host_mask) -> tuple:
+    res = score_nodes(
+        arrays, used, tg_count, spread_counts, penalty, req, class_elig,
+        host_mask,
+    )
+    row = jnp.argmax(res.final).astype(jnp.int32)
+    ok = res.final[row] > NEG_INF / 2
+    return (
+        jnp.where(ok, row, -1),
+        # Failed placements report 0 score/binpack, matching the placement
+        # scan's convention (place_task_group) so consumers can aggregate
+        # without re-masking.
+        jnp.where(ok, res.final[row], 0.0),
+        jnp.where(ok, res.binpack[row], 0.0),
+        res.needs_preempt[row] & ok,
+        jnp.sum(res.feasible.astype(jnp.int32)),
+        # Filtered counts exclude capacity-padding / ineligible rows, like
+        # the placement scan's n_filtered.
+        jnp.sum((~res.feasible & arrays.eligible).astype(jnp.int32)),
+        jnp.sum((res.feasible & ~res.fits).astype(jnp.int32)),
+    )
+
+
+@jax.jit
+def score_batch(arrays, used, tg_counts, spread_counts, penalties, reqs,
+                class_eligs, host_masks) -> BatchScoreResult:
+    """B independent evaluations in ONE dispatch: full ranking over every
+    node for each, then per-eval argmax.
+
+    This is where the TPU design earns its keep versus the reference: where
+    Nomad bounds *per-eval* work (shuffle + log₂(n) candidates + po2c,
+    stack.go:78-91) and scales via optimistic worker concurrency, we score
+    all nodes for a whole *batch* of evals as one (B, N) data-parallel
+    program. Conflicting picks are caught by the plan applier's re-verify —
+    the same optimistic-concurrency contract the reference already relies on
+    (plan_apply.go:49-69).
+
+    Batched args lead with a B axis: tg_counts (B,N), spread_counts (B,S,V),
+    penalties (B,N), reqs a stacked SchedRequest pytree, class_eligs (B,K),
+    host_masks (B,N). ``arrays`` and ``used`` are shared.
+    """
+    outs = jax.vmap(
+        lambda tg, sc, pen, req, ce, hm: _score_and_pick(
+            arrays, used, tg, sc, pen, req, ce, hm
+        )
+    )(tg_counts, spread_counts, penalties, reqs, class_eligs, host_masks)
+    return BatchScoreResult(*outs)
+
+
+# ---------------------------------------------------------------------------
 # Placement scan
 # ---------------------------------------------------------------------------
 
