@@ -1,0 +1,23 @@
+"""Client (node agent) — runs allocations on a node.
+
+Reference: ``client/`` (SURVEY.md §2.3): registration + heartbeat, a
+blocking-query watch loop on the node's allocations, AllocRunner →
+TaskRunner hook pipelines over pluggable task drivers, restart policies, and
+batched status updates back to the servers.
+"""
+
+from .client import Client, ClientConfig
+from .driver import DriverRegistry, MockDriver, RawExecDriver, TaskHandle
+from .allocrunner import AllocRunner
+from .taskrunner import TaskRunner
+
+__all__ = [
+    "Client",
+    "ClientConfig",
+    "DriverRegistry",
+    "MockDriver",
+    "RawExecDriver",
+    "TaskHandle",
+    "AllocRunner",
+    "TaskRunner",
+]

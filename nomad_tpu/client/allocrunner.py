@@ -1,0 +1,188 @@
+"""AllocRunner — per-allocation lifecycle over its TaskRunners.
+
+Reference: ``client/allocrunner/alloc_runner.go`` (1241 LoC): alloc-dir hook,
+task lifecycle ordering (prestart → main → poststop,
+``task_hook_coordinator.go``), client-status rollup from task states, update
+handling (server pushed a new desired status), and destroy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs.types import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Allocation,
+    Task,
+    TaskState,
+)
+from .driver import DriverRegistry
+from .taskrunner import TaskRunner
+
+log = logging.getLogger(__name__)
+
+
+class AllocRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        drivers: DriverRegistry,
+        data_dir: str,
+        on_alloc_update: Callable[["AllocRunner"], None],
+    ):
+        self.alloc = alloc
+        self.drivers = drivers
+        self.on_alloc_update = on_alloc_update
+        self.alloc_dir = os.path.join(data_dir, alloc.id)
+        self.client_status = AllocClientStatus.PENDING.value
+        self.task_states: Dict[str, TaskState] = {}
+        self.runners: Dict[str, TaskRunner] = {}
+        self._lock = threading.Lock()
+        self._destroyed = False
+        self._thread: Optional[threading.Thread] = None
+        self._waiters: List[TaskRunner] = []
+
+    # ------------------------------------------------------------------
+
+    def _tasks(self) -> List[Task]:
+        job = self.alloc.job
+        if job is None:
+            return []
+        tg = job.lookup_task_group(self.alloc.task_group)
+        return list(tg.tasks) if tg else []
+
+    def run(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"alloc-{self.alloc.id[:8]}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        # Alloc-dir hook: shared + per-task dirs (client/allocdir layout).
+        os.makedirs(os.path.join(self.alloc_dir, "alloc"), exist_ok=True)
+
+        tasks = self._tasks()
+        if not tasks:
+            self._set_status(AllocClientStatus.FAILED.value, "no tasks")
+            return
+
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group)
+        restart = tg.restart_policy if tg else None
+
+        # Lifecycle ordering (task_hook_coordinator.go): prestart non-sidecar
+        # tasks run to completion before main tasks launch.
+        prestart = [t for t in tasks if t.lifecycle_hook == "prestart"
+                    and not t.lifecycle_sidecar]
+        sidecars = [t for t in tasks if t.lifecycle_hook == "prestart"
+                    and t.lifecycle_sidecar]
+        main = [t for t in tasks if not t.lifecycle_hook]
+        poststop = [t for t in tasks if t.lifecycle_hook == "poststop"]
+
+        def launch(task: Task) -> TaskRunner:
+            tr = TaskRunner(
+                alloc_id=self.alloc.id,
+                task=task,
+                driver=self.drivers.get(task.driver),
+                task_dir=os.path.join(self.alloc_dir, task.name),
+                restart_policy=restart or tg.restart_policy,
+                on_state_change=self._on_task_state,
+            )
+            with self._lock:
+                self.runners[task.name] = tr
+            tr.start()
+            return tr
+
+        for t in prestart:
+            tr = launch(t)
+            tr.wait()
+            if tr.state.failed:
+                self._finalize()
+                return
+        for t in sidecars + main:
+            launch(t)
+        main_runners = [self.runners[t.name] for t in main]
+        for tr in main_runners:
+            tr.wait()
+        # Main tasks done → kill sidecars, run poststop.
+        for t in sidecars:
+            self.runners[t.name].kill()
+        for t in poststop:
+            if not self._destroyed:
+                launch(t).wait()
+        self._finalize()
+
+    # ------------------------------------------------------------------
+
+    def _on_task_state(self, name: str, state: TaskState) -> None:
+        with self._lock:
+            self.task_states[name] = state
+            self._rollup_locked()
+        self.on_alloc_update(self)
+
+    def _rollup_locked(self) -> None:
+        """Client status from task states (alloc_runner.go
+        getClientStatus): any failed → failed; all MAIN tasks dead+ok →
+        complete; any running → running."""
+        states = list(self.task_states.values())
+        if not states:
+            return
+        main_names = [t.name for t in self._tasks() if not t.lifecycle_hook]
+        main_states = [
+            self.task_states[n] for n in main_names if n in self.task_states
+        ]
+        if any(s.failed for s in states):
+            self.client_status = AllocClientStatus.FAILED.value
+        elif len(main_states) == len(main_names) and all(
+            s.state == "dead" for s in main_states
+        ):
+            self.client_status = AllocClientStatus.COMPLETE.value
+        elif any(s.state == "running" for s in states):
+            self.client_status = AllocClientStatus.RUNNING.value
+        else:
+            self.client_status = AllocClientStatus.PENDING.value
+
+    def _finalize(self) -> None:
+        with self._lock:
+            self._rollup_locked()
+            if self.client_status == AllocClientStatus.RUNNING.value:
+                self.client_status = AllocClientStatus.COMPLETE.value
+        self.on_alloc_update(self)
+
+    def _set_status(self, status: str, desc: str = "") -> None:
+        with self._lock:
+            self.client_status = status
+        self.on_alloc_update(self)
+
+    # ------------------------------------------------------------------
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new alloc version (runAllocs diff 'update')."""
+        self.alloc = alloc
+        if alloc.desired_status != AllocDesiredStatus.RUN.value:
+            self.kill()
+
+    def kill(self) -> None:
+        for tr in list(self.runners.values()):
+            tr.kill()
+
+    def destroy(self) -> None:
+        self._destroyed = True
+        self.kill()
+        for tr in list(self.runners.values()):
+            tr.wait(timeout=5)
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+
+    @property
+    def terminal(self) -> bool:
+        return self.client_status in (
+            AllocClientStatus.COMPLETE.value,
+            AllocClientStatus.FAILED.value,
+            AllocClientStatus.LOST.value,
+        )

@@ -1,0 +1,213 @@
+"""Client core — registration, heartbeats, the alloc watch loop.
+
+Reference: ``client/client.go``: ``registerAndHeartbeat`` (:1550), the
+``watchAllocations`` blocking query on ``Node.GetClientAllocs`` (:1997),
+``runAllocs`` diffing server state into AllocRunner add/update/destroy
+(:2227), and batched alloc-status updates back to the server (200ms batches,
+:95-97). The RPC boundary here is the in-process ``Server`` object; the wire
+version slots in behind the same three calls (register/heartbeat/get-allocs/
+update-allocs).
+"""
+
+from __future__ import annotations
+
+import logging
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs.types import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Allocation,
+    DriverInfo,
+    Node,
+    NodeStatus,
+)
+from .allocrunner import AllocRunner
+from .driver import DriverRegistry
+from .fingerprint import fingerprint
+
+log = logging.getLogger(__name__)
+
+# Batch window for alloc status updates (client.go:95-97).
+UPDATE_BATCH_WINDOW = 0.2
+
+
+@dataclass
+class ClientConfig:
+    datacenter: str = "dc1"
+    node_class: str = ""
+    data_dir: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    # Fraction of the granted TTL at which to heartbeat (client sends early).
+    heartbeat_factor: float = 0.5
+
+
+class Client:
+    def __init__(
+        self,
+        server,
+        config: Optional[ClientConfig] = None,
+        drivers: Optional[DriverRegistry] = None,
+        node: Optional[Node] = None,
+    ):
+        self.server = server
+        self.config = config or ClientConfig()
+        self.drivers = drivers or DriverRegistry()
+        self.data_dir = self.config.data_dir or tempfile.mkdtemp(
+            prefix="nomad_tpu_client_"
+        )
+
+        attrs, resources = fingerprint()
+        attrs.update(self.drivers.fingerprint())
+        self.node = node or Node(
+            datacenter=self.config.datacenter,
+            node_class=self.config.node_class,
+            attributes=attrs,
+            meta=dict(self.config.meta),
+            resources=resources,
+            drivers={
+                name: DriverInfo(detected=True, healthy=True)
+                for name in self.drivers.drivers
+            },
+            status=NodeStatus.INIT.value,
+        )
+
+        self.allocs: Dict[str, AllocRunner] = {}
+        self._lock = threading.Lock()
+        self._dirty: Dict[str, AllocRunner] = {}  # pending status updates
+        self._dirty_cond = threading.Condition(self._lock)
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._ttl = 10.0
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Register and launch the heartbeat / watch / update loops."""
+        self._ttl = self.server.register_node(self.node)
+        self.node.status = NodeStatus.READY.value
+        self.server.update_node_status(self.node.id, NodeStatus.READY.value)
+        for target, name in (
+            (self._heartbeat_loop, "heartbeat"),
+            (self._watch_allocations, "watch-allocs"),
+            (self._update_loop, "update-allocs"),
+        ):
+            t = threading.Thread(
+                target=target, name=f"client-{name}-{self.node.id[:8]}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._dirty_cond:
+            self._dirty_cond.notify_all()
+        for ar in list(self.allocs.values()):
+            ar.destroy()
+
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            wait = max(self._ttl * self.config.heartbeat_factor, 0.5)
+            if self._shutdown.wait(timeout=wait):
+                return
+            try:
+                self._ttl = self.server.heartbeat_node(self.node.id) or self._ttl
+            except Exception:  # noqa: BLE001
+                log.exception("heartbeat failed")
+
+    # ------------------------------------------------------------------
+
+    def _watch_allocations(self) -> None:
+        """Blocking-query loop (client.go:1997): wake on allocs-table bumps,
+        diff into runAllocs."""
+        index = 0
+        while not self._shutdown.is_set():
+            try:
+                allocs, index = self.server.get_client_allocs(
+                    self.node.id, min_index=index, timeout=1.0
+                )
+            except Exception:  # noqa: BLE001
+                log.exception("alloc watch failed")
+                time.sleep(1)
+                continue
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, server_allocs: List[Allocation]) -> None:
+        """Diff server view vs local runners (client.go:2227)."""
+        server_by_id = {a.id: a for a in server_allocs}
+        with self._lock:
+            existing = dict(self.allocs)
+
+        # Removed server-side (GC'd) → destroy local state.
+        for aid, ar in existing.items():
+            if aid not in server_by_id:
+                ar.destroy()
+                with self._lock:
+                    self.allocs.pop(aid, None)
+
+        for aid, alloc in server_by_id.items():
+            ar = existing.get(aid)
+            if ar is None:
+                if alloc.terminal_status():
+                    continue  # already finished; nothing to run
+                if alloc.desired_status != AllocDesiredStatus.RUN.value:
+                    continue
+                ar = AllocRunner(
+                    alloc, self.drivers, self.data_dir, self._alloc_updated
+                )
+                with self._lock:
+                    self.allocs[aid] = ar
+                ar.run()
+            elif alloc.modify_index > ar.alloc.modify_index:
+                ar.update(alloc)
+
+    # ------------------------------------------------------------------
+
+    def _alloc_updated(self, ar: AllocRunner) -> None:
+        with self._dirty_cond:
+            self._dirty[ar.alloc.id] = ar
+            self._dirty_cond.notify_all()
+
+    def _update_loop(self) -> None:
+        """Batch status updates back to the server (Node.UpdateAlloc path,
+        client.go:2363)."""
+        while not self._shutdown.is_set():
+            with self._dirty_cond:
+                self._dirty_cond.wait_for(
+                    lambda: self._dirty or self._shutdown.is_set(), timeout=1.0
+                )
+                if self._shutdown.is_set():
+                    return
+                if not self._dirty:
+                    continue
+                batch_start = time.time()
+            # Let the batch window fill (200ms).
+            time.sleep(UPDATE_BATCH_WINDOW)
+            with self._dirty_cond:
+                dirty, self._dirty = self._dirty, {}
+            updates = []
+            for ar in dirty.values():
+                upd = ar.alloc.copy()
+                upd.client_status = ar.client_status
+                upd.task_states = {
+                    k: v for k, v in ar.task_states.items()
+                }
+                updates.append(upd)
+            if updates:
+                try:
+                    self.server.update_allocs_from_client(updates)
+                except Exception:  # noqa: BLE001
+                    log.exception("alloc update failed")
+
+    # ------------------------------------------------------------------
+
+    def num_allocs(self) -> int:
+        with self._lock:
+            return len(self.allocs)

@@ -1,0 +1,309 @@
+"""Task drivers — the pluggable execution boundary.
+
+Reference: the driver plugin protocol (``plugins/drivers/driver.go:47-65``):
+Fingerprint, StartTask, WaitTask, StopTask, DestroyTask, RecoverTask,
+InspectTask. The reference isolates drivers behind a gRPC process boundary
+(go-plugin); here the protocol is the same Python interface, with the C++
+executor slotting underneath the exec driver (SURVEY.md §2.4 mapping).
+
+Two built-ins:
+
+- ``MockDriver`` — fully scriptable fake (reference: ``drivers/mock/``,
+  the cornerstone of client/integration testing): start errors, run_for,
+  exit codes, kill_after, start_block_for.
+- ``RawExecDriver`` — un-isolated subprocess execution (reference:
+  ``drivers/rawexec/``).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..structs.types import Task
+
+
+@dataclass
+class ExitResult:
+    exit_code: int = 0
+    signal: int = 0
+    err: str = ""
+    oom_killed: bool = False
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.err
+
+
+@dataclass
+class TaskHandle:
+    """Opaque, re-attachable handle to a running task (reference:
+    drivers.TaskHandle — persisted so RecoverTask can re-attach after an
+    agent restart)."""
+
+    id: str
+    driver: str
+    task_name: str
+    alloc_id: str
+    config: Dict[str, Any] = field(default_factory=dict)
+    pid: int = 0
+    started_at: float = 0.0
+
+
+class DriverError(Exception):
+    pass
+
+
+class Driver:
+    """Base driver interface."""
+
+    name = "driver"
+
+    def fingerprint(self) -> Dict[str, str]:
+        """Attributes to merge into the node (driver.X detected/healthy)."""
+        return {f"driver.{self.name}": "1"}
+
+    def start_task(self, handle: TaskHandle, task: Task, task_dir: str) -> None:
+        raise NotImplementedError
+
+    def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        raise NotImplementedError
+
+    def stop_task(self, handle: TaskHandle, kill_timeout: float) -> None:
+        raise NotImplementedError
+
+    def destroy_task(self, handle: TaskHandle) -> None:
+        pass
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        """Re-attach to a still-running task after agent restart
+        (driver.go:54). Returns False when the task is gone."""
+        return False
+
+    def inspect_task(self, handle: TaskHandle) -> str:
+        return "unknown"
+
+
+class _MockInstance:
+    def __init__(self):
+        self.done = threading.Event()
+        self.result: Optional[ExitResult] = None
+        self.timer: Optional[threading.Timer] = None
+
+
+class MockDriver(Driver):
+    """Scriptable fake driver. Task ``config`` knobs (reference:
+    drivers/mock/driver.go:74-80):
+
+    - ``start_error``: error message raised from start_task
+    - ``start_error_recoverable``: marks the error recoverable
+    - ``start_block_for``: seconds start_task blocks before returning
+    - ``run_for``: seconds the task runs before exiting
+    - ``exit_code`` / ``exit_signal`` / ``exit_err_msg``
+    - ``kill_after``: seconds to keep running after a stop request
+    """
+
+    name = "mock"
+
+    def __init__(self):
+        self._instances: Dict[str, _MockInstance] = {}
+        self._lock = threading.Lock()
+
+    def start_task(self, handle: TaskHandle, task: Task, task_dir: str) -> None:
+        cfg = task.config or {}
+        if cfg.get("start_error"):
+            raise DriverError(str(cfg["start_error"]))
+        block = float(cfg.get("start_block_for", 0))
+        if block:
+            time.sleep(block)
+        inst = _MockInstance()
+        with self._lock:
+            self._instances[handle.id] = inst
+        run_for = float(cfg.get("run_for", 0))
+        result = ExitResult(
+            exit_code=int(cfg.get("exit_code", 0)),
+            signal=int(cfg.get("exit_signal", 0)),
+            err=str(cfg.get("exit_err_msg", "")),
+        )
+
+        def finish():
+            inst.result = result
+            inst.done.set()
+
+        if run_for > 0:
+            inst.timer = threading.Timer(run_for, finish)
+            inst.timer.daemon = True
+            inst.timer.start()
+        elif run_for == 0 and "run_for" in cfg:
+            finish()  # exits immediately
+        # run_for unset -> runs until stopped
+        handle.pid = os.getpid()
+        handle.started_at = time.time()
+        handle.config = dict(cfg)
+
+    def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None):
+        inst = self._instances.get(handle.id)
+        if inst is None:
+            return ExitResult(err="unknown task")
+        if not inst.done.wait(timeout=timeout):
+            return None
+        return inst.result
+
+    def stop_task(self, handle: TaskHandle, kill_timeout: float) -> None:
+        inst = self._instances.get(handle.id)
+        if inst is None:
+            return
+        kill_after = float(handle.config.get("kill_after", 0))
+        delay = min(kill_after, kill_timeout) if kill_after else 0.0
+
+        def finish():
+            inst.result = ExitResult(exit_code=0, signal=9)
+            inst.done.set()
+
+        if delay > 0:
+            t = threading.Timer(delay, finish)
+            t.daemon = True
+            t.start()
+        else:
+            finish()
+
+    def destroy_task(self, handle: TaskHandle) -> None:
+        with self._lock:
+            inst = self._instances.pop(handle.id, None)
+        if inst and inst.timer:
+            inst.timer.cancel()
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        # In-process driver: instances die with the agent, like a container
+        # runtime losing its containers on host reboot.
+        return handle.id in self._instances
+
+    def inspect_task(self, handle: TaskHandle) -> str:
+        inst = self._instances.get(handle.id)
+        if inst is None:
+            return "unknown"
+        return "exited" if inst.done.is_set() else "running"
+
+
+class RawExecDriver(Driver):
+    """Un-isolated subprocess execution (reference: drivers/rawexec/).
+
+    Task config: ``command`` (required), ``args`` (list). The C++ executor
+    supervisor (nomad_tpu native runtime) slots under this same interface.
+    """
+
+    name = "raw_exec"
+
+    def __init__(self):
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def start_task(self, handle: TaskHandle, task: Task, task_dir: str) -> None:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("raw_exec requires config.command")
+        args = [str(command)] + [str(a) for a in cfg.get("args", [])]
+        stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab")
+        stderr = open(os.path.join(task_dir, f"{task.name}.stderr"), "ab")
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in (task.env or {}).items()})
+        try:
+            proc = subprocess.Popen(
+                args, cwd=task_dir, stdout=stdout, stderr=stderr, env=env,
+                start_new_session=True,
+            )
+        except OSError as exc:
+            raise DriverError(str(exc)) from exc
+        finally:
+            stdout.close()
+            stderr.close()
+        with self._lock:
+            self._procs[handle.id] = proc
+        handle.pid = proc.pid
+        handle.started_at = time.time()
+
+    def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None):
+        proc = self._procs.get(handle.id)
+        if proc is None:
+            return ExitResult(err="unknown task")
+        try:
+            code = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        if code < 0:
+            return ExitResult(exit_code=0, signal=-code)
+        return ExitResult(exit_code=code)
+
+    def stop_task(self, handle: TaskHandle, kill_timeout: float) -> None:
+        proc = self._procs.get(handle.id)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+
+        def hard_kill():
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+        t = threading.Timer(kill_timeout, hard_kill)
+        t.daemon = True
+        t.start()
+
+    def destroy_task(self, handle: TaskHandle) -> None:
+        proc = self._procs.pop(handle.id, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        if handle.id in self._procs:
+            return True
+        if handle.pid:
+            try:
+                os.kill(handle.pid, 0)
+                return True  # process alive but unsupervised; re-attachable
+            except (ProcessLookupError, PermissionError):
+                return False
+        return False
+
+    def inspect_task(self, handle: TaskHandle) -> str:
+        proc = self._procs.get(handle.id)
+        if proc is None:
+            return "unknown"
+        return "running" if proc.poll() is None else "exited"
+
+
+class DriverRegistry:
+    """Per-client driver instances (reference: client/pluginmanager/
+    drivermanager — dispense + fingerprint)."""
+
+    def __init__(self, drivers: Optional[Dict[str, Driver]] = None):
+        self.drivers: Dict[str, Driver] = drivers or {
+            "mock": MockDriver(),
+            "raw_exec": RawExecDriver(),
+        }
+
+    def get(self, name: str) -> Driver:
+        d = self.drivers.get(name)
+        if d is None:
+            raise DriverError(f"unknown driver {name!r}")
+        return d
+
+    def fingerprint(self) -> Dict[str, str]:
+        attrs: Dict[str, str] = {}
+        for d in self.drivers.values():
+            attrs.update(d.fingerprint())
+        return attrs

@@ -1,0 +1,205 @@
+"""TaskRunner — per-task lifecycle FSM.
+
+Reference: ``client/allocrunner/taskrunner/task_runner.go:467`` (Run): a hook
+pipeline (validate, taskdir, artifacts, templates... — trimmed here to the
+ones with behavior in this build), driver start, wait, then the client-side
+restart policy (``client/allocrunner/taskrunner/restarts/``): attempts per
+interval, delay, mode fail|delay.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from ..structs.types import RestartPolicy, Task, TaskState
+from .driver import Driver, DriverError, ExitResult, TaskHandle
+
+log = logging.getLogger(__name__)
+
+# Task event types (reference: structs.TaskEvent constants).
+EVENT_RECEIVED = "Received"
+EVENT_TASK_SETUP = "Task Setup"
+EVENT_STARTED = "Started"
+EVENT_TERMINATED = "Terminated"
+EVENT_RESTARTING = "Restarting"
+EVENT_NOT_RESTARTING = "Not Restarting"
+EVENT_KILLING = "Killing"
+EVENT_KILLED = "Killed"
+EVENT_DRIVER_FAILURE = "Driver Failure"
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        alloc_id: str,
+        task: Task,
+        driver: Driver,
+        task_dir: str,
+        restart_policy: RestartPolicy,
+        on_state_change: Callable[[str, TaskState], None],
+    ):
+        self.alloc_id = alloc_id
+        self.task = task
+        self.driver = driver
+        self.task_dir = task_dir
+        self.restart_policy = restart_policy
+        self.on_state_change = on_state_change
+
+        self.state = TaskState()
+        self.handle: Optional[TaskHandle] = None
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._restarts_in_interval: List[float] = []
+
+    # ------------------------------------------------------------------
+
+    def _event(self, etype: str, message: str = "") -> None:
+        self.state.events.append(
+            {"type": etype, "time": time.time(), "message": message}
+        )
+
+    def _set_state(self, state: str, failed: bool = False) -> None:
+        self.state.state = state
+        if failed:
+            self.state.failed = True
+        if state == "running" and not self.state.started_at:
+            self.state.started_at = time.time()
+        if state == "dead":
+            self.state.finished_at = time.time()
+        self.on_state_change(self.task.name, self.state)
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"task-{self.task.name}", daemon=True
+        )
+        self._thread.start()
+
+    def run(self) -> None:
+        """MAIN loop: hooks → start → wait → restart decision."""
+        self._event(EVENT_RECEIVED)
+        try:
+            self._prestart_hooks()
+        except Exception as exc:  # noqa: BLE001
+            self._event(EVENT_DRIVER_FAILURE, str(exc))
+            self._set_state("dead", failed=True)
+            self._done.set()
+            return
+
+        while not self._kill.is_set():
+            result = self._run_once()
+            if self._kill.is_set():
+                break
+            restart, delay = self._should_restart(result)
+            if not restart:
+                self._event(
+                    EVENT_NOT_RESTARTING, "Exceeded allowed attempts"
+                    if result is not None and not result.successful()
+                    else "",
+                )
+                self._set_state(
+                    "dead",
+                    failed=result is None or not result.successful(),
+                )
+                self._done.set()
+                return
+            self._event(EVENT_RESTARTING, f"restarting in {delay:.1f}s")
+            self.state.restarts += 1
+            self.on_state_change(self.task.name, self.state)
+            if self._kill.wait(timeout=delay):
+                break
+
+        # Killed.
+        self._event(EVENT_KILLED)
+        self._set_state("dead", failed=False)
+        self._done.set()
+
+    def _prestart_hooks(self) -> None:
+        """validate + taskdir hooks (task_runner_hooks.go:50-160, trimmed:
+        no logmon/artifact/template/vault machinery yet)."""
+        self._event(EVENT_TASK_SETUP)
+        if not self.task.driver:
+            raise ValueError("task has no driver")
+        os.makedirs(self.task_dir, exist_ok=True)
+
+    def _run_once(self) -> Optional[ExitResult]:
+        """One driver start + wait cycle. None result = start failure."""
+        handle = TaskHandle(
+            id=uuid.uuid4().hex,
+            driver=self.driver.name,
+            task_name=self.task.name,
+            alloc_id=self.alloc_id,
+        )
+        try:
+            self.driver.start_task(handle, self.task, self.task_dir)
+        except DriverError as exc:
+            # Transient until the restart policy gives up — the final dead
+            # transition sets `failed`, not each attempt.
+            self._event(EVENT_DRIVER_FAILURE, str(exc))
+            return None
+        self.handle = handle
+        self._event(EVENT_STARTED)
+        self._set_state("running")
+
+        # Wait for exit OR kill.
+        while True:
+            result = self.driver.wait_task(handle, timeout=0.1)
+            if result is not None:
+                self._event(
+                    EVENT_TERMINATED,
+                    f"exit={result.exit_code} signal={result.signal} "
+                    f"err={result.err}",
+                )
+                self.driver.destroy_task(handle)
+                return result
+            if self._kill.is_set():
+                self._event(EVENT_KILLING)
+                self.driver.stop_task(handle, self.task.kill_timeout)
+                result = self.driver.wait_task(
+                    handle, timeout=self.task.kill_timeout + 1.0
+                )
+                self.driver.destroy_task(handle)
+                return result or ExitResult(signal=9)
+
+    # ------------------------------------------------------------------
+
+    def _should_restart(self, result: Optional[ExitResult]):
+        """Restart policy (reference: restarts/restarts.go): ``attempts``
+        restarts per ``interval``; past that, mode=fail → dead, mode=delay →
+        wait out the interval and reset."""
+        policy = self.restart_policy
+        if result is not None and result.successful():
+            return False, 0.0  # main task completed
+        now = time.time()
+        self._restarts_in_interval = [
+            t for t in self._restarts_in_interval
+            if now - t < policy.interval
+        ]
+        if len(self._restarts_in_interval) >= policy.attempts:
+            if policy.mode == "delay":
+                oldest = self._restarts_in_interval[0]
+                wait = max(policy.interval - (now - oldest), policy.delay)
+                self._restarts_in_interval = []
+                return True, wait
+            return False, 0.0
+        self._restarts_in_interval.append(now)
+        return True, policy.delay
+
+    # ------------------------------------------------------------------
+
+    def kill(self) -> None:
+        self._kill.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout=timeout)
+
+    @property
+    def dead(self) -> bool:
+        return self._done.is_set()

@@ -72,6 +72,14 @@ _NUMERIC_OPS = {
 _VERSION_RE = re.compile(r"^\s*(>=|<=|>|<|=)?\s*v?(\d+(?:\.\d+){0,2})\s*$")
 
 
+def pow2_bucket(n: int) -> int:
+    """Round a count up to a power of two. Used for every padded shape that
+    feeds a jit'd kernel (placement-scan lengths, class-eligibility vectors)
+    so the jit cache stays bounded (SURVEY.md §7 hard-part e). The single
+    source of truth — stack and parallel batch-building must agree."""
+    return 1 << max(0, (n - 1)).bit_length()
+
+
 class SchedRequest(NamedTuple):
     """Device-side encoding of one task-group placement ask."""
 

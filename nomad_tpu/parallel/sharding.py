@@ -35,7 +35,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.encode import SchedRequest
+from ..ops.encode import SchedRequest, pow2_bucket
 from ..ops.kernels import NEG_INF, score_nodes
 from ..state.matrix import DeviceArrays
 
@@ -50,6 +50,10 @@ def make_mesh(
     """
     devs = jax.devices()
     n = n_devices if n_devices is not None else len(devs)
+    assert len(devs) >= n, (
+        f"requested {n} devices but only {len(devs)} visible — set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual mesh"
+    )
     if batch is None:
         batch = 2 if n % 2 == 0 and n >= 2 else 1
     assert n % batch == 0, f"{n} devices not divisible by batch={batch}"
@@ -60,10 +64,6 @@ def make_mesh(
 def stack_requests(reqs: Sequence[SchedRequest]) -> SchedRequest:
     """Stack B per-eval requests into one batched pytree (leading B axis)."""
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *reqs)
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1)).bit_length()
 
 
 def build_batch_inputs(matrix, requests: Sequence[SchedRequest]) -> dict:
@@ -79,7 +79,7 @@ def build_batch_inputs(matrix, requests: Sequence[SchedRequest]) -> dict:
     )
     b = len(requests)
     n = matrix.capacity
-    pad = _next_pow2(max(1, len(matrix.class_ids)))
+    pad = pow2_bucket(max(1, len(matrix.class_ids)))
     return dict(
         reqs=reqs,
         tg_counts=jnp.zeros((b, n), jnp.int32),
@@ -179,7 +179,9 @@ def _step_local(arrays, used, tg_counts, spread_counts, penalties, reqs,
         evaluated = jax.lax.psum(
             jnp.sum(res.feasible.astype(jnp.int32)), "node"
         )
-        return row, jnp.where(ok, best, NEG_INF), pre, evaluated, req.ask
+        # Failed placements report score 0.0, matching score_batch /
+        # place_task_group so consumers can aggregate without re-masking.
+        return row, jnp.where(ok, best, 0.0), pre, evaluated, req.ask
 
     rows, scores, pre, evaluated, asks = jax.vmap(one)(
         tg_counts, spread_counts, penalties, reqs, class_eligs, host_masks

@@ -23,7 +23,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..ops.encode import CompiledTaskGroup, RequestEncoder, MAX_SPREAD_VALUES
+from ..ops.encode import (
+    CompiledTaskGroup,
+    MAX_SPREAD_VALUES,
+    RequestEncoder,
+    pow2_bucket as _pow2_bucket,
+)
 from ..ops import kernels
 from ..state.matrix import DEVICE_LOCK, NodeMatrix, node_attributes, stable_hash
 from ..structs.types import (
@@ -64,10 +69,6 @@ class SelectionOption:
     assigned_ports: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
 
-def _pow2_bucket(n: int) -> int:
-    """Round placement counts up to a power of two so lax.scan lengths (and
-    hence jit cache entries) stay bounded (SURVEY.md §7 hard-part e)."""
-    return 1 << max(0, (n - 1)).bit_length()
 
 
 class GenericStack:

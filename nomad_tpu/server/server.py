@@ -17,7 +17,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..state.matrix import NodeMatrix, computed_class_key, node_attributes
 from ..state.store import StateStore
@@ -472,6 +472,15 @@ class Server:
     # ------------------------------------------------------------------
     # Introspection helpers
     # ------------------------------------------------------------------
+
+    def get_client_allocs(
+        self, node_id: str, min_index: int = 0, timeout: float = 30.0
+    ) -> Tuple[List[Allocation], int]:
+        """Blocking query for a node's allocations (Node.GetClientAllocs,
+        node_endpoint.go:915): blocks until the allocs table passes
+        ``min_index`` (or timeout), then returns (allocs, table_index)."""
+        index = self.store.wait_for_table("allocs", min_index, timeout=timeout)
+        return self.store.allocs_by_node(node_id), max(index, min_index)
 
     def wait_for_eval(
         self, eval_id: str, timeout: float = 10.0

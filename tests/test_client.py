@@ -1,0 +1,208 @@
+"""Client-agent integration tests (tier 2, SURVEY.md §4): a real in-process
+Server plus real Clients running the scriptable mock driver — the
+multi-node-without-containers pattern the reference uses
+(client/testing.go + drivers/mock)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.types import (
+    AllocClientStatus,
+    EvalStatus,
+    RestartPolicy,
+    Task,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(
+        ServerConfig(num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90)
+    )
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _small(job):
+    """Shrink asks: the fingerprinted test node may expose only 1 core."""
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.cpu = 20
+            t.resources.memory_mb = 32
+        tg.ephemeral_disk.size_mb = 10
+    return job
+
+
+def _client(server, tmp_path, **cfg) -> Client:
+    c = Client(
+        server,
+        ClientConfig(data_dir=str(tmp_path / "client"), **cfg),
+    )
+    c.start()
+    return c
+
+
+def _wait(pred, timeout=30.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _live(server, job):
+    return [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+
+
+class TestClientLifecycle:
+    def test_service_job_runs_on_client(self, server, tmp_path):
+        client = _client(server, tmp_path)
+        try:
+            job = _small(mock.job())
+            job.task_groups[0].count = 3
+            # Long-running mock tasks (no run_for → run until stopped).
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(
+                lambda: len(
+                    [
+                        a
+                        for a in server.store.allocs_by_job(
+                            job.namespace, job.id
+                        )
+                        if a.client_status == AllocClientStatus.RUNNING.value
+                    ]
+                )
+                == 3
+            ), "allocs should report running via client updates"
+            assert client.num_allocs() == 3
+        finally:
+            client.shutdown()
+
+    def test_batch_job_completes(self, server, tmp_path):
+        client = _client(server, tmp_path)
+        try:
+            job = _small(mock.batch_job())
+            job.task_groups[0].count = 2
+            job.task_groups[0].tasks[0].config = {"run_for": 0.2}
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(
+                lambda: all(
+                    a.client_status == AllocClientStatus.COMPLETE.value
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                )
+                and len(server.store.allocs_by_job(job.namespace, job.id)) == 2
+            )
+        finally:
+            client.shutdown()
+
+    def test_failing_task_restarts_then_fails(self, server, tmp_path):
+        client = _client(server, tmp_path)
+        try:
+            job = _small(mock.batch_job())
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.restart_policy = RestartPolicy(
+                attempts=1, interval=300.0, delay=0.05, mode="fail"
+            )
+            tg.reschedule_policy = None
+            tg.tasks[0].config = {"run_for": 0.05, "exit_code": 1}
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(
+                lambda: any(
+                    a.client_status == AllocClientStatus.FAILED.value
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                )
+            )
+            failed = [
+                a
+                for a in server.store.allocs_by_job(job.namespace, job.id)
+                if a.client_status == AllocClientStatus.FAILED.value
+            ][0]
+            # One restart attempt happened before giving up.
+            ts = failed.task_states.get(tg.tasks[0].name)
+            assert ts is not None and ts.restarts == 1
+        finally:
+            client.shutdown()
+
+    def test_job_stop_kills_allocs(self, server, tmp_path):
+        client = _client(server, tmp_path)
+        try:
+            job = _small(mock.job())
+            job.task_groups[0].count = 2
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            _wait(
+                lambda: len(
+                    [
+                        a
+                        for a in server.store.allocs_by_job(
+                            job.namespace, job.id
+                        )
+                        if a.client_status == AllocClientStatus.RUNNING.value
+                    ]
+                )
+                == 2
+            )
+            ev2 = server.deregister_job(job.namespace, job.id)
+            server.wait_for_eval(ev2.id, timeout=90)
+            # Client kills tasks; allocs end complete (stopped, not failed).
+            assert _wait(
+                lambda: all(
+                    a.client_terminal()
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                )
+            )
+        finally:
+            client.shutdown()
+
+    def test_two_clients_share_load(self, server, tmp_path):
+        c1 = _client(server, tmp_path / "c1")
+        c2 = _client(server, tmp_path / "c2")
+        try:
+            job = _small(mock.job())
+            job.task_groups[0].count = 8
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(
+                lambda: c1.num_allocs() + c2.num_allocs() == 8, timeout=30
+            )
+        finally:
+            c1.shutdown()
+            c2.shutdown()
+
+    def test_raw_exec_driver(self, server, tmp_path):
+        client = _client(server, tmp_path)
+        try:
+            job = _small(mock.batch_job())
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0] = Task(
+                name="echo",
+                driver="raw_exec",
+                config={"command": "/bin/sh", "args": ["-c", "echo hi"]},
+                resources=tg.tasks[0].resources,
+            )
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(
+                lambda: all(
+                    a.client_status == AllocClientStatus.COMPLETE.value
+                    for a in server.store.allocs_by_job(job.namespace, job.id)
+                )
+                and server.store.allocs_by_job(job.namespace, job.id)
+            )
+        finally:
+            client.shutdown()
