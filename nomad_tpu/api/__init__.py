@@ -1,0 +1,7 @@
+"""HTTP API + agent (reference: command/agent/ — http.go:252-324 routes)."""
+
+from .agent import Agent, AgentConfig
+from .http_server import HTTPAPIServer
+from .client import APIClient
+
+__all__ = ["Agent", "AgentConfig", "HTTPAPIServer", "APIClient"]

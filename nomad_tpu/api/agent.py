@@ -1,0 +1,108 @@
+"""Agent — server and/or client plus the HTTP API in one process.
+
+Reference: ``command/agent/agent.go`` (NewAgent boots nomad.NewServer and/or
+client.NewClient in-process) + ``command/agent/http.go`` (HTTPServer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..client import Client, ClientConfig
+from ..server import Server, ServerConfig
+
+
+@dataclass
+class AgentConfig:
+    name: str = "agent-1"
+    region: str = "global"
+    datacenter: str = "dc1"
+    server_enabled: bool = True
+    client_enabled: bool = True
+    http_host: str = "127.0.0.1"
+    http_port: int = 0  # 0 = ephemeral
+    server_config: ServerConfig = field(default_factory=ServerConfig)
+    client_config: ClientConfig = field(default_factory=ClientConfig)
+
+
+class Agent:
+    def __init__(self, config: Optional[AgentConfig] = None):
+        self.config = config or AgentConfig()
+        self.started_at = 0.0
+        self.server: Optional[Server] = None
+        self.client: Optional[Client] = None
+        if self.config.server_enabled:
+            self.server = Server(self.config.server_config)
+        if self.config.client_enabled:
+            if self.server is None:
+                raise ValueError(
+                    "client-only agents need a remote server (not yet wired)"
+                )
+            self.config.client_config.datacenter = self.config.datacenter
+            self.client = Client(self.server, self.config.client_config)
+
+        from .http_server import HTTPAPIServer
+
+        self.http = HTTPAPIServer(
+            self, host=self.config.http_host, port=self.config.http_port
+        )
+        self.rpc_addr = self.http.addr
+
+    def start(self) -> None:
+        self.started_at = time.time()
+        if self.server is not None:
+            self.server.start()
+        if self.client is not None:
+            self.client.start()
+        self.http.start()
+
+    def shutdown(self) -> None:
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+        self.http.shutdown()
+
+    # ------------------------------------------------------------------
+
+    def member_info(self) -> Dict:
+        return {
+            "Name": self.config.name,
+            "Region": self.config.region,
+            "Datacenter": self.config.datacenter,
+            "Server": self.server is not None,
+            "Client": self.client is not None,
+            "Addr": self.rpc_addr,
+            "Status": "alive",
+        }
+
+    def metrics(self) -> Dict:
+        out: Dict = {"uptime_s": round(time.time() - self.started_at, 1)}
+        if self.server is not None:
+            s = self.server
+            out.update(
+                {
+                    "nomad.broker.total_ready": s.eval_broker.ready_count(),
+                    "nomad.broker.total_unacked": s.eval_broker.unacked_count(),
+                    "nomad.broker.total_pending": s.eval_broker.pending_count(),
+                    "nomad.blocked_evals.total_blocked":
+                        s.blocked_evals.blocked_count(),
+                    "nomad.plan.queue_depth": s.plan_queue.depth(),
+                    "nomad.plan.applied": s.plan_applier.plans_applied,
+                    "nomad.plan.partial": s.plan_applier.plans_partial,
+                    "nomad.state.nodes": len(s.store.nodes),
+                    "nomad.state.jobs": len(s.store.jobs),
+                    "nomad.state.allocs": len(s.store.allocs),
+                    "nomad.state.evals": len(s.store.evals),
+                    "nomad.worker.evals_processed": sum(
+                        w.evals_processed for w in s.workers
+                    ),
+                    "nomad.heartbeat.active": s.heartbeater.tracked(),
+                }
+            )
+        if self.client is not None:
+            out["client.allocs_running"] = self.client.num_allocs()
+        return out

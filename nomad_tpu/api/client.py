@@ -1,0 +1,134 @@
+"""HTTP API client — what the CLI and external users consume.
+
+Reference: the ``api/`` Go client package (api/jobs.go etc.).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"HTTP {code}: {message}")
+        self.code = code
+
+
+class APIClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646"):
+        self.address = address.rstrip("/")
+
+    def _call(
+        self, method: str, path: str, body: Optional[Any] = None
+    ) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.address}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as exc:
+            try:
+                msg = json.loads(exc.read()).get("error", str(exc))
+            except Exception:  # noqa: BLE001
+                msg = str(exc)
+            raise APIError(exc.code, msg) from exc
+
+    # Jobs ------------------------------------------------------------
+
+    def register_job(self, job_payload: Dict) -> Dict:
+        return self._call("PUT", "/v1/jobs", {"Job": job_payload})
+
+    def list_jobs(self, prefix: str = "") -> List[Dict]:
+        return self._call("GET", f"/v1/jobs?prefix={prefix}")
+
+    def get_job(self, job_id: str, namespace: str = "default") -> Dict:
+        return self._call("GET", f"/v1/job/{job_id}?namespace={namespace}")
+
+    def deregister_job(
+        self, job_id: str, purge: bool = False, namespace: str = "default"
+    ) -> Dict:
+        return self._call(
+            "DELETE",
+            f"/v1/job/{job_id}?namespace={namespace}"
+            f"&purge={'true' if purge else 'false'}",
+        )
+
+    def job_allocations(self, job_id: str, namespace: str = "default"):
+        return self._call(
+            "GET", f"/v1/job/{job_id}/allocations?namespace={namespace}"
+        )
+
+    def job_evaluations(self, job_id: str, namespace: str = "default"):
+        return self._call(
+            "GET", f"/v1/job/{job_id}/evaluations?namespace={namespace}"
+        )
+
+    def job_summary(self, job_id: str, namespace: str = "default"):
+        return self._call(
+            "GET", f"/v1/job/{job_id}/summary?namespace={namespace}"
+        )
+
+    def parse_job_hcl(self, hcl: str) -> Dict:
+        return self._call("POST", "/v1/jobs/parse", {"JobHCL": hcl})
+
+    # Nodes -----------------------------------------------------------
+
+    def list_nodes(self) -> List[Dict]:
+        return self._call("GET", "/v1/nodes")
+
+    def get_node(self, node_id: str) -> Dict:
+        return self._call("GET", f"/v1/node/{node_id}")
+
+    def node_allocations(self, node_id: str):
+        return self._call("GET", f"/v1/node/{node_id}/allocations")
+
+    def drain_node(
+        self, node_id: str, enable: bool = True, deadline: float = 3600.0
+    ) -> Dict:
+        body = {"DrainSpec": {"Deadline": deadline}} if enable else {
+            "DrainSpec": None, "MarkEligible": True,
+        }
+        return self._call("PUT", f"/v1/node/{node_id}/drain", body)
+
+    def set_node_eligibility(self, node_id: str, eligible: bool) -> Dict:
+        return self._call(
+            "PUT",
+            f"/v1/node/{node_id}/eligibility",
+            {"Eligibility": "eligible" if eligible else "ineligible"},
+        )
+
+    # Evals / allocs ----------------------------------------------------
+
+    def get_evaluation(self, eval_id: str) -> Dict:
+        return self._call("GET", f"/v1/evaluation/{eval_id}")
+
+    def get_allocation(self, alloc_id: str) -> Dict:
+        return self._call("GET", f"/v1/allocation/{alloc_id}")
+
+    def stop_allocation(self, alloc_id: str) -> Dict:
+        return self._call("PUT", f"/v1/allocation/{alloc_id}/stop")
+
+    # Operator / agent --------------------------------------------------
+
+    def members(self) -> Dict:
+        return self._call("GET", "/v1/agent/members")
+
+    def leader(self) -> str:
+        return self._call("GET", "/v1/status/leader")
+
+    def scheduler_configuration(self) -> Dict:
+        return self._call("GET", "/v1/operator/scheduler/configuration")
+
+    def set_scheduler_configuration(self, config: Dict) -> Dict:
+        return self._call(
+            "PUT", "/v1/operator/scheduler/configuration", config
+        )
+
+    def metrics(self) -> Dict:
+        return self._call("GET", "/v1/metrics")

@@ -1,0 +1,295 @@
+"""HTTP API — the ``/v1`` surface.
+
+Reference: ``command/agent/http.go:252-324`` route registration. JSON over
+HTTP; the CLI and external tooling consume this, mirroring the reference's
+api/ package contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..jobspec import api_to_job, parse_job
+from ..structs.types import DrainStrategy, SchedulerConfiguration
+
+
+def _dump(obj: Any, exclude: Tuple[str, ...] = ()) -> Any:
+    if obj is None:
+        return None
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d = dataclasses.asdict(obj)
+        for k in exclude:
+            d.pop(k, None)
+        return d
+    if isinstance(obj, list):
+        return [_dump(o, exclude) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _dump(v, exclude) for k, v in obj.items()}
+    return obj
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class HTTPAPIServer:
+    """Routes requests onto the in-process agent (server and/or client)."""
+
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0):
+        self.agent = agent
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _respond(self, code: int, payload: Any) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self, method: str) -> None:
+                try:
+                    parsed = urlparse(self.path)
+                    query = {
+                        k: v[0] for k, v in parse_qs(parsed.query).items()
+                    }
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    body = json.loads(raw) if raw else None
+                    result = api.route(method, parsed.path, query, body)
+                    self._respond(200, result)
+                except HTTPError as exc:
+                    self._respond(exc.code, {"error": exc.message})
+                except Exception as exc:  # noqa: BLE001
+                    self._respond(500, {"error": str(exc)})
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.addr = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="http-api", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ------------------------------------------------------------------
+    # Routing (http.go:252-324)
+    # ------------------------------------------------------------------
+
+    def route(self, method: str, path: str, query: Dict, body: Any) -> Any:
+        server = self.agent.server
+        if server is None:
+            raise HTTPError(501, "agent is not running a server")
+        store = server.store
+
+        if path == "/v1/jobs" and method == "GET":
+            prefix = query.get("prefix", "")
+            return [
+                self._job_stub(j)
+                for j in store.all_jobs()
+                if j.id.startswith(prefix)
+            ]
+        if path == "/v1/jobs" and method in ("PUT", "POST"):
+            payload = (body or {}).get("Job", body)
+            if payload is None:
+                raise HTTPError(400, "missing job")
+            job = api_to_job(payload)
+            ev = server.submit_job(job)
+            return {"EvalID": ev.id if ev else "", "JobModifyIndex":
+                    store.job_by_id(job.namespace, job.id).modify_index}
+        if path == "/v1/jobs/parse" and method == "POST":
+            hcl = (body or {}).get("JobHCL", "")
+            if not hcl:
+                raise HTTPError(400, "missing JobHCL")
+            return _dump(parse_job(hcl))
+
+        m = re.match(r"^/v1/job/([^/]+)$", path)
+        if m:
+            ns = query.get("namespace", "default")
+            job = store.job_by_id(ns, m.group(1))
+            if method == "GET":
+                if job is None:
+                    raise HTTPError(404, "job not found")
+                return _dump(job)
+            if method == "DELETE":
+                purge = query.get("purge", "") in ("true", "1")
+                ev = server.deregister_job(ns, m.group(1), purge=purge)
+                if ev is None:
+                    raise HTTPError(404, "job not found")
+                return {"EvalID": ev.id}
+        m = re.match(r"^/v1/job/([^/]+)/allocations$", path)
+        if m and method == "GET":
+            ns = query.get("namespace", "default")
+            return _dump(store.allocs_by_job(ns, m.group(1)), exclude=("job",))
+        m = re.match(r"^/v1/job/([^/]+)/evaluations$", path)
+        if m and method == "GET":
+            ns = query.get("namespace", "default")
+            return _dump(store.evals_by_job(ns, m.group(1)))
+        m = re.match(r"^/v1/job/([^/]+)/summary$", path)
+        if m and method == "GET":
+            ns = query.get("namespace", "default")
+            summary = store.job_summaries.get((ns, m.group(1)))
+            if summary is None:
+                raise HTTPError(404, "job not found")
+            return {
+                "JobID": summary.job_id,
+                "Namespace": summary.namespace,
+                "Summary": summary.summary,
+            }
+
+        if path == "/v1/nodes" and method == "GET":
+            return [
+                self._node_stub(n) for n in store.nodes.values()
+            ]
+        m = re.match(r"^/v1/node/([^/]+)$", path)
+        if m and method == "GET":
+            node = store.node_by_id(m.group(1))
+            if node is None:
+                raise HTTPError(404, "node not found")
+            return _dump(node)
+        m = re.match(r"^/v1/node/([^/]+)/allocations$", path)
+        if m and method == "GET":
+            return _dump(store.allocs_by_node(m.group(1)), exclude=("job",))
+        m = re.match(r"^/v1/node/([^/]+)/drain$", path)
+        if m and method in ("PUT", "POST"):
+            spec = (body or {}).get("DrainSpec")
+            strategy = None
+            if spec is not None:
+                strategy = DrainStrategy(
+                    deadline=float(spec.get("Deadline", 3600.0)),
+                    ignore_system_jobs=bool(
+                        spec.get("IgnoreSystemJobs", False)
+                    ),
+                )
+            server.update_node_drain(
+                m.group(1), strategy,
+                mark_eligible=bool((body or {}).get("MarkEligible", False)),
+            )
+            return {"NodeModifyIndex": store.latest_index}
+        m = re.match(r"^/v1/node/([^/]+)/eligibility$", path)
+        if m and method in ("PUT", "POST"):
+            elig = (body or {}).get("Eligibility", "eligible")
+            server.update_node_eligibility(m.group(1), elig)
+            return {"NodeModifyIndex": store.latest_index}
+
+        if path == "/v1/evaluations" and method == "GET":
+            return _dump(list(store.evals.values()))
+        m = re.match(r"^/v1/evaluation/([^/]+)$", path)
+        if m and method == "GET":
+            ev = store.eval_by_id(m.group(1))
+            if ev is None:
+                raise HTTPError(404, "eval not found")
+            return _dump(ev)
+        m = re.match(r"^/v1/evaluation/([^/]+)/allocations$", path)
+        if m and method == "GET":
+            return _dump(store.allocs_by_eval(m.group(1)), exclude=("job",))
+
+        if path == "/v1/allocations" and method == "GET":
+            return _dump(list(store.allocs.values()), exclude=("job",))
+        m = re.match(r"^/v1/allocation/([^/]+)$", path)
+        if m and method == "GET":
+            alloc = store.alloc_by_id(m.group(1))
+            if alloc is None:
+                raise HTTPError(404, "alloc not found")
+            return _dump(alloc, exclude=("job",))
+        m = re.match(r"^/v1/allocation/([^/]+)/stop$", path)
+        if m and method in ("PUT", "POST"):
+            ev = server.stop_alloc(m.group(1))
+            if ev is None:
+                raise HTTPError(404, "alloc not found")
+            return {"EvalID": ev.id}
+
+        if path == "/v1/status/leader" and method == "GET":
+            return self.agent.rpc_addr
+        if path == "/v1/agent/members" and method == "GET":
+            return {"Members": [self.agent.member_info()]}
+        if path == "/v1/agent/self" and method == "GET":
+            return self.agent.member_info()
+
+        if path == "/v1/operator/scheduler/configuration":
+            if method == "GET":
+                return _dump(store.scheduler_config)
+            if method in ("PUT", "POST"):
+                cfg = store.scheduler_config
+                new = SchedulerConfiguration(
+                    scheduler_algorithm=(body or {}).get(
+                        "scheduler_algorithm", cfg.scheduler_algorithm
+                    ),
+                    preemption_config=cfg.preemption_config,
+                    memory_oversubscription_enabled=(body or {}).get(
+                        "memory_oversubscription_enabled",
+                        cfg.memory_oversubscription_enabled,
+                    ),
+                )
+                pc = (body or {}).get("preemption_config")
+                if pc:
+                    new.preemption_config = dataclasses.replace(
+                        cfg.preemption_config, **pc
+                    )
+                store.set_scheduler_config(server.next_index(), new)
+                return {"Updated": True}
+
+        if path == "/v1/metrics" and method == "GET":
+            return self.agent.metrics()
+
+        raise HTTPError(404, f"no handler for {method} {path}")
+
+    @staticmethod
+    def _job_stub(job) -> Dict[str, Any]:
+        return {
+            "id": job.id,
+            "name": job.name,
+            "namespace": job.namespace,
+            "type": job.type,
+            "priority": job.priority,
+            "status": job.status,
+            "stop": job.stop,
+            "version": job.version,
+            "modify_index": job.modify_index,
+        }
+
+    @staticmethod
+    def _node_stub(node) -> Dict[str, Any]:
+        return {
+            "id": node.id,
+            "name": node.name,
+            "datacenter": node.datacenter,
+            "node_class": node.node_class,
+            "status": node.status,
+            "drain": node.drain,
+            "scheduling_eligibility": node.scheduling_eligibility,
+        }

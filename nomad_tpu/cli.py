@@ -1,0 +1,325 @@
+"""CLI — the user surface (reference: ``command/`` ~100 subcommands; this
+covers the core operational set: agent, job run/status/stop/plan-parse,
+node status/drain/eligibility, alloc status, eval status, server members,
+operator scheduler config, metrics)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .api.client import APIClient, APIError
+from .jobspec import job_to_api, parse_job
+
+DEFAULT_ADDR = os.environ.get("NOMAD_TPU_ADDR", "http://127.0.0.1:4646")
+
+
+def _client(args) -> APIClient:
+    return APIClient(args.address)
+
+
+def _print(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def cmd_agent(args) -> int:
+    from .api.agent import Agent, AgentConfig
+    from .server.server import ServerConfig
+
+    config = AgentConfig(
+        name=args.name,
+        datacenter=args.dc,
+        server_enabled=not args.client_only,
+        client_enabled=not args.server_only,
+        http_host=args.bind,
+        http_port=args.port,
+        server_config=ServerConfig(num_workers=args.workers),
+    )
+    agent = Agent(config)
+    agent.start()
+    print(f"agent started; HTTP API at {agent.rpc_addr}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("shutting down")
+        agent.shutdown()
+    return 0
+
+
+def cmd_job_run(args) -> int:
+    src = open(args.jobfile).read()
+    job = parse_job(src)
+    client = _client(args)
+    result = client.register_job(job_to_api(job))
+    print(f"Job {job.id!r} registered; eval {result.get('EvalID', '')}")
+    if args.detach:
+        return 0
+    eval_id = result.get("EvalID")
+    if not eval_id:
+        return 0
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        ev = client.get_evaluation(eval_id)
+        if ev["status"] in ("complete", "failed", "cancelled"):
+            print(f"Evaluation {eval_id[:8]} {ev['status']}")
+            if ev.get("queued_allocations"):
+                queued = {
+                    k: v
+                    for k, v in ev["queued_allocations"].items()
+                    if v
+                }
+                if queued:
+                    print(f"Queued (unplaced): {queued}")
+            for a in client.job_allocations(job.id, job.namespace):
+                print(
+                    f"  alloc {a['id'][:8]} {a['name']} -> node "
+                    f"{a['node_id'][:8]} [{a['client_status']}]"
+                )
+            return 0
+        time.sleep(0.2)
+    print("timed out waiting for evaluation")
+    return 1
+
+
+def cmd_job_status(args) -> int:
+    client = _client(args)
+    if not args.job_id:
+        for stub in client.list_jobs():
+            print(
+                f"{stub['id']:40} {stub['type']:8} prio={stub['priority']:3} "
+                f"{stub['status']}{' (stopped)' if stub['stop'] else ''}"
+            )
+        return 0
+    job = client.get_job(args.job_id, args.namespace)
+    print(f"ID       = {job['id']}")
+    print(f"Name     = {job['name']}")
+    print(f"Type     = {job['type']}")
+    print(f"Priority = {job['priority']}")
+    print(f"Status   = {job['status']}{' (stopped)' if job['stop'] else ''}")
+    try:
+        summary = client.job_summary(args.job_id, args.namespace)
+        print("Summary:")
+        for tg, counts in summary["Summary"].items():
+            shown = {k: v for k, v in counts.items() if v}
+            print(f"  {tg}: {shown or '{}'}")
+    except APIError:
+        pass
+    print("Allocations:")
+    for a in client.job_allocations(args.job_id, args.namespace):
+        print(
+            f"  {a['id'][:8]} {a['name']:32} node={a['node_id'][:8]} "
+            f"desired={a['desired_status']} status={a['client_status']}"
+        )
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    client = _client(args)
+    result = client.deregister_job(
+        args.job_id, purge=args.purge, namespace=args.namespace
+    )
+    print(f"Job {args.job_id!r} stopping; eval {result.get('EvalID', '')}")
+    return 0
+
+
+def cmd_job_parse(args) -> int:
+    job = parse_job(open(args.jobfile).read())
+    _print(dataclasses.asdict(job))
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    client = _client(args)
+    if not args.node_id:
+        for n in client.list_nodes():
+            print(
+                f"{n['id'][:8]} {n['name']:24} {n['datacenter']:8} "
+                f"{n['status']:12} drain={n['drain']} "
+                f"{n['scheduling_eligibility']}"
+            )
+        return 0
+    node = client.get_node(args.node_id)
+    _print(node)
+    print("Allocations:")
+    for a in client.node_allocations(args.node_id):
+        print(
+            f"  {a['id'][:8]} {a['name']:32} desired={a['desired_status']} "
+            f"status={a['client_status']}"
+        )
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    client = _client(args)
+    client.drain_node(
+        args.node_id, enable=not args.disable, deadline=args.deadline
+    )
+    print(
+        f"Node {args.node_id[:8]} drain "
+        f"{'disabled' if args.disable else 'enabled'}"
+    )
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    client = _client(args)
+    client.set_node_eligibility(args.node_id, args.enable)
+    print(
+        f"Node {args.node_id[:8]} marked "
+        f"{'eligible' if args.enable else 'ineligible'}"
+    )
+    return 0
+
+
+def cmd_alloc_status(args) -> int:
+    client = _client(args)
+    alloc = client.get_allocation(args.alloc_id)
+    keep = (
+        "id", "name", "node_id", "job_id", "task_group", "desired_status",
+        "client_status", "create_time",
+    )
+    _print({k: alloc[k] for k in keep if k in alloc})
+    if args.verbose and alloc.get("metrics"):
+        _print(alloc["metrics"])
+    if alloc.get("task_states"):
+        print("Task states:")
+        for name, ts in alloc["task_states"].items():
+            print(
+                f"  {name}: {ts['state']} failed={ts['failed']} "
+                f"restarts={ts['restarts']}"
+            )
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    client = _client(args)
+    _print(client.get_evaluation(args.eval_id))
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    _print(_client(args).members())
+    return 0
+
+
+def cmd_operator_scheduler(args) -> int:
+    client = _client(args)
+    if args.algorithm:
+        client.set_scheduler_configuration(
+            {"scheduler_algorithm": args.algorithm}
+        )
+    _print(client.scheduler_configuration())
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    _print(_client(args).metrics())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nomad-tpu", description="TPU-native workload orchestrator"
+    )
+    p.add_argument("--address", default=DEFAULT_ADDR)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    agent = sub.add_parser("agent", help="run an agent (server+client)")
+    agent.add_argument("--name", default="agent-1")
+    agent.add_argument("--dc", default="dc1")
+    agent.add_argument("--bind", default="127.0.0.1")
+    agent.add_argument("--port", type=int, default=4646)
+    agent.add_argument("--workers", type=int, default=2)
+    agent.add_argument("--server-only", action="store_true")
+    agent.add_argument("--client-only", action="store_true")
+    agent.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job operations").add_subparsers(
+        dest="job_cmd", required=True
+    )
+    run = job.add_parser("run")
+    run.add_argument("jobfile")
+    run.add_argument("-detach", action="store_true")
+    run.set_defaults(fn=cmd_job_run)
+    status = job.add_parser("status")
+    status.add_argument("job_id", nargs="?")
+    status.add_argument("--namespace", default="default")
+    status.set_defaults(fn=cmd_job_status)
+    stop = job.add_parser("stop")
+    stop.add_argument("job_id")
+    stop.add_argument("-purge", action="store_true")
+    stop.add_argument("--namespace", default="default")
+    stop.set_defaults(fn=cmd_job_stop)
+    parse = job.add_parser("parse")
+    parse.add_argument("jobfile")
+    parse.set_defaults(fn=cmd_job_parse)
+
+    node = sub.add_parser("node", help="node operations").add_subparsers(
+        dest="node_cmd", required=True
+    )
+    nstatus = node.add_parser("status")
+    nstatus.add_argument("node_id", nargs="?")
+    nstatus.set_defaults(fn=cmd_node_status)
+    drain = node.add_parser("drain")
+    drain.add_argument("node_id")
+    drain.add_argument("-disable", action="store_true")
+    drain.add_argument("--deadline", type=float, default=3600.0)
+    drain.set_defaults(fn=cmd_node_drain)
+    elig = node.add_parser("eligibility")
+    elig.add_argument("node_id")
+    elig.add_argument("-enable", dest="enable", action="store_true")
+    elig.add_argument("-disable", dest="enable", action="store_false")
+    elig.set_defaults(fn=cmd_node_eligibility, enable=True)
+
+    alloc = sub.add_parser("alloc", help="allocation ops").add_subparsers(
+        dest="alloc_cmd", required=True
+    )
+    astatus = alloc.add_parser("status")
+    astatus.add_argument("alloc_id")
+    astatus.add_argument("-verbose", action="store_true")
+    astatus.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval", help="evaluation ops").add_subparsers(
+        dest="eval_cmd", required=True
+    )
+    estatus = ev.add_parser("status")
+    estatus.add_argument("eval_id")
+    estatus.set_defaults(fn=cmd_eval_status)
+
+    sm = sub.add_parser("server", help="server ops").add_subparsers(
+        dest="server_cmd", required=True
+    )
+    sm.add_parser("members").set_defaults(fn=cmd_server_members)
+
+    op = sub.add_parser("operator", help="operator ops").add_subparsers(
+        dest="operator_cmd", required=True
+    )
+    sched = op.add_parser("scheduler")
+    sched.add_argument("--algorithm", choices=["binpack", "spread"])
+    sched.set_defaults(fn=cmd_operator_scheduler)
+
+    metrics = sub.add_parser("metrics", help="agent metrics")
+    metrics.set_defaults(fn=cmd_metrics)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except APIError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

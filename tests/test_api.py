@@ -1,0 +1,321 @@
+"""Jobspec parsing + HTTP API + CLI tests (reference test strategy: the
+api/ and command/ suites run against a real agent; here the agent is
+in-process with a real HTTP listener on an ephemeral port)."""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.jobspec.hcl import HCLParseError, parse_hcl
+from nomad_tpu.jobspec.parse import duration
+
+EXAMPLE_HCL = """
+# An example job.
+job "web-app" {
+  datacenters = ["dc1", "dc2"]
+  type = "service"
+  priority = 70
+
+  meta {
+    owner = "team-a"
+  }
+
+  constraint {
+    attribute = "${attr.kernel.name}"
+    value     = "linux"
+  }
+
+  update {
+    max_parallel = 2
+    canary       = 1
+    auto_revert  = true
+    min_healthy_time = "15s"
+  }
+
+  group "web" {
+    count = 3
+
+    restart {
+      attempts = 2
+      interval = "30m"
+      delay    = "15s"
+      mode     = "fail"
+    }
+
+    ephemeral_disk {
+      size = 500
+    }
+
+    spread {
+      attribute = "${attr.rack}"
+      weight    = 50
+      target "r1" { percent = 60 }
+      target "r2" { percent = 40 }
+    }
+
+    network {
+      port "http" {}
+      port "admin" { static = 9901 }
+    }
+
+    task "server" {
+      driver = "mock"
+
+      config {
+        run_for = 10
+      }
+
+      env {
+        PORT = "8080"
+      }
+
+      resources {
+        cpu    = 250
+        memory = 128
+      }
+
+      affinity {
+        attribute = "${attr.platform.tpu.type}"
+        value     = "v5e"
+        weight    = 75
+      }
+
+      service "web-svc" {
+        port = "http"
+        tags = ["frontend"]
+      }
+    }
+
+    task "sidecar" {
+      driver = "mock"
+      lifecycle {
+        hook    = "prestart"
+        sidecar = true
+      }
+      resources {
+        cpu    = 50
+        memory = 32
+      }
+    }
+  }
+
+  group "worker" {
+    count = 2
+    task "work" {
+      driver = "mock"
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+"""
+
+
+class TestHCL:
+    def test_full_job_parse(self):
+        job = parse_job(EXAMPLE_HCL)
+        assert job.id == "web-app"
+        assert job.priority == 70
+        assert job.datacenters == ["dc1", "dc2"]
+        assert job.meta == {"owner": "team-a"}
+        assert len(job.constraints) == 1
+        assert job.constraints[0].l_target == "${attr.kernel.name}"
+        assert job.update.canary == 1 and job.update.auto_revert
+        assert job.update.min_healthy_time == 15.0
+
+        assert [g.name for g in job.task_groups] == ["web", "worker"]
+        web = job.task_groups[0]
+        assert web.count == 3
+        assert web.restart_policy.interval == 1800.0
+        assert web.ephemeral_disk.size_mb == 500
+        assert web.spreads[0].targets[0].value == "r1"
+        assert web.networks[0].dynamic_ports == ["http"]
+        assert web.networks[0].reserved_ports == [9901]
+
+        server = web.tasks[0]
+        assert server.name == "server"
+        assert server.config == {"run_for": 10}
+        assert server.env == {"PORT": "8080"}
+        assert server.resources.cpu == 250
+        assert server.affinities[0].weight == 75
+        assert server.services[0].name == "web-svc"
+        sidecar = web.tasks[1]
+        assert sidecar.lifecycle_hook == "prestart"
+        assert sidecar.lifecycle_sidecar
+
+    def test_comments_and_heredoc(self):
+        tree = parse_hcl(
+            'a = 1 // trailing\n'
+            '/* block\ncomment */\n'
+            'b = "x"\n'
+            'c = <<EOT\nmulti\nline\nEOT\n'
+        )
+        assert tree == {"a": 1, "b": "x", "c": "multi\nline"}
+
+    def test_lists_maps_bools(self):
+        tree = parse_hcl(
+            'xs = [1, 2, 3]\nm = { a = 1, b = "two" }\nflag = true\n'
+        )
+        assert tree == {
+            "xs": [1, 2, 3], "m": {"a": 1, "b": "two"}, "flag": True
+        }
+
+    def test_parse_error_has_line(self):
+        with pytest.raises(HCLParseError) as exc:
+            parse_hcl('a = 1\nb = = 2\n')
+        assert "line 2" in str(exc.value)
+
+    def test_duration(self):
+        assert duration("1h30m") == 5400.0
+        assert duration("15s") == 15.0
+        assert duration("500ms") == 0.5
+        assert duration(42) == 42.0
+        assert duration(None, 7.0) == 7.0
+
+    def test_json_roundtrip(self):
+        from nomad_tpu.jobspec import job_to_api
+
+        job = parse_job(EXAMPLE_HCL)
+        payload = job_to_api(job)
+        job2 = parse_job(json.dumps(payload))
+        assert job2.id == job.id
+        assert len(job2.task_groups) == 2
+        assert job2.task_groups[0].tasks[0].resources.cpu == 250
+        assert job2.update.canary == 1
+
+
+@pytest.fixture
+def agent(tmp_path):
+    from nomad_tpu.api import Agent, AgentConfig
+    from nomad_tpu.client import ClientConfig
+    from nomad_tpu.server import ServerConfig
+
+    cfg = AgentConfig(
+        server_config=ServerConfig(
+            num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ),
+        client_config=ClientConfig(data_dir=str(tmp_path / "client")),
+    )
+    a = Agent(cfg)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+SMALL_JOB = """
+job "tiny" {
+  datacenters = ["dc1"]
+  group "g" {
+    count = 2
+    ephemeral_disk { size = 10 }
+    task "t" {
+      driver = "mock"
+      resources { cpu = 20 memory = 32 }
+    }
+  }
+}
+"""
+
+
+class TestHTTPAPI:
+    def test_job_lifecycle_over_http(self, agent):
+        from nomad_tpu.api.client import APIClient
+        from nomad_tpu.jobspec import job_to_api
+
+        c = APIClient(agent.rpc_addr)
+        job = parse_job(SMALL_JOB)
+        result = c.register_job(job_to_api(job))
+        assert result["EvalID"]
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            ev = c.get_evaluation(result["EvalID"])
+            if ev["status"] == "complete":
+                break
+            time.sleep(0.1)
+        assert ev["status"] == "complete"
+
+        allocs = c.job_allocations("tiny")
+        assert len(allocs) == 2
+        assert all("job" not in a for a in allocs)  # stripped in lists
+
+        nodes = c.list_nodes()
+        assert len(nodes) == 1 and nodes[0]["status"] == "ready"
+
+        summary = c.job_summary("tiny")
+        assert "g" in summary["Summary"]
+
+        stop = c.deregister_job("tiny")
+        assert stop["EvalID"]
+
+    def test_parse_endpoint(self, agent):
+        from nomad_tpu.api.client import APIClient
+
+        c = APIClient(agent.rpc_addr)
+        parsed = c.parse_job_hcl(SMALL_JOB)
+        assert parsed["id"] == "tiny"
+        assert parsed["task_groups"][0]["count"] == 2
+
+    def test_scheduler_config_endpoint(self, agent):
+        from nomad_tpu.api.client import APIClient
+
+        c = APIClient(agent.rpc_addr)
+        cfg = c.scheduler_configuration()
+        assert cfg["scheduler_algorithm"] == "binpack"
+        c.set_scheduler_configuration({"scheduler_algorithm": "spread"})
+        assert (
+            c.scheduler_configuration()["scheduler_algorithm"] == "spread"
+        )
+
+    def test_404s(self, agent):
+        from nomad_tpu.api.client import APIClient, APIError
+
+        c = APIClient(agent.rpc_addr)
+        with pytest.raises(APIError) as exc:
+            c.get_job("nope")
+        assert exc.value.code == 404
+
+    def test_metrics_and_members(self, agent):
+        from nomad_tpu.api.client import APIClient
+
+        c = APIClient(agent.rpc_addr)
+        m = c.metrics()
+        assert "nomad.state.nodes" in m
+        members = c.members()
+        assert members["Members"][0]["Server"]
+
+
+class TestCLI:
+    def test_job_run_and_status(self, agent, tmp_path, capsys):
+        from nomad_tpu.cli import main
+
+        jobfile = tmp_path / "job.hcl"
+        jobfile.write_text(SMALL_JOB)
+        rc = main(
+            ["--address", agent.rpc_addr, "job", "run", str(jobfile)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "registered" in out and "complete" in out
+
+        rc = main(["--address", agent.rpc_addr, "job", "status", "tiny"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "tiny" in out and "Allocations" in out
+
+        rc = main(["--address", agent.rpc_addr, "node", "status"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "ready" in out
+
+        rc = main(["--address", agent.rpc_addr, "job", "stop", "tiny"])
+        assert rc == 0
+
+    def test_job_parse_cmd(self, tmp_path, capsys):
+        from nomad_tpu.cli import main
+
+        jobfile = tmp_path / "job.hcl"
+        jobfile.write_text(SMALL_JOB)
+        rc = main(["job", "parse", str(jobfile)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert json.loads(out)["id"] == "tiny"
