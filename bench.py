@@ -1,13 +1,23 @@
 """C2M-scale scheduler benchmark (driver entry).
 
 Simulates the reference's headline scale — 10K nodes carrying ~2M
-allocations (BASELINE.md / SURVEY.md §6) — and measures evaluation
-throughput of the batched TPU scheduler: each eval scores EVERY node (no
-candidate sampling) and argmaxes, B evals per kernel dispatch, optimistic
-concurrency left to the plan applier exactly as in the live server.
+allocations (BASELINE.md / SURVEY.md §6) — and measures BOTH:
+
+1. **Kernel dispatch throughput**: the batched TPU scheduler kernel (each
+   eval scores EVERY node, no candidate sampling, B evals per dispatch).
+2. **End-to-end server-loop throughput**: evals driven through
+   broker → worker → snapshot-sync → stack → plan queue → serialized
+   applier (the full optimistic-concurrency path), matching the
+   reference's ``nomad.worker.invoke_scheduler`` + ``nomad.plan.*``
+   timers (worker.go:245, plan_apply.go:185,370,401).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Target (BASELINE.json): >= 50K evals/sec, p99 < 5 ms, on 1x TPU v5e.
+
+Backend hardening (round-1 postmortem): ``jax.devices()`` is retried with
+backoff; if the TPU backend cannot initialize at all, the bench re-execs
+itself once with ``JAX_PLATFORMS=cpu`` so a number (with ``platform``
+disclosed) is always produced instead of rc=1.
 """
 
 from __future__ import annotations
@@ -26,6 +36,90 @@ BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 # Enough samples that p99 is a real tail statistic, not the max.
 DISPATCHES = int(os.environ.get("BENCH_DISPATCHES", "300"))
 JOB_SHAPES = 8
+
+# End-to-end loop knobs.
+E2E = os.environ.get("BENCH_E2E", "1") != "0"
+E2E_JOBS = int(os.environ.get("BENCH_E2E_JOBS", "256"))
+E2E_GROUP_COUNT = int(os.environ.get("BENCH_E2E_COUNT", "2"))
+E2E_PROBES = int(os.environ.get("BENCH_E2E_PROBES", "50"))
+E2E_WORKERS = int(os.environ.get("BENCH_E2E_WORKERS", "4"))
+
+
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+
+
+def _fallback_to_cpu(reason: str) -> None:
+    """Re-exec once with the CPU platform forced (jax caches backend-init
+    failure in-process, so re-exec beats flipping config)."""
+    if os.environ.get("BENCH_CPU_FALLBACK") == "1":
+        return
+    sys.stderr.write(f"bench: {reason}; re-exec with JAX_PLATFORMS=cpu\n")
+    sys.stderr.flush()
+    sys.stdout.flush()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CPU_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def init_backend() -> str:
+    """Bring up the jax backend defensively; never burn the whole round.
+
+    Two observed failure modes (round 1 + round 2 verification):
+    - ``jax.devices()`` raises UNAVAILABLE (TPU backend setup error) —
+      retried below with backoff.
+    - ``jax.devices()`` HANGS forever (wedged TPU tunnel; a registered
+      plugin backend can block in make_c_api_client).  A hang cannot be
+      recovered in-process, so first PROBE backend init in a disposable
+      subprocess with a timeout; if the probe dies or times out, re-exec
+      with the CPU platform forced so a number (with ``platform``
+      disclosed) is always produced.
+    """
+    if (
+        os.environ.get("BENCH_CPU_FALLBACK") != "1"
+        and os.environ.get("JAX_PLATFORMS") != "cpu"
+    ):
+        import subprocess
+
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT,
+            )
+            if p.returncode != 0:
+                _fallback_to_cpu(
+                    f"backend probe failed rc={p.returncode}: "
+                    f"{p.stderr[-500:]}"
+                )
+        except subprocess.TimeoutExpired:
+            _fallback_to_cpu(
+                f"backend probe hung >{PROBE_TIMEOUT}s (wedged tunnel?)"
+            )
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # A registered TPU-tunnel plugin backend can initialize (and hang)
+        # even under JAX_PLATFORMS=cpu — drop non-CPU backend factories
+        # before first backend init.
+        from __graft_entry__ import _scrub_non_cpu_backends
+
+        _scrub_non_cpu_backends()
+    import jax
+
+    last: Exception | None = None
+    for attempt in range(4):
+        try:
+            return jax.devices()[0].platform
+        except Exception as e:  # noqa: BLE001
+            last = e
+            sys.stderr.write(
+                f"bench: jax backend init failed "
+                f"(attempt {attempt + 1}/4): {e}\n"
+            )
+            time.sleep(5.0 * (attempt + 1))
+    _fallback_to_cpu("TPU backend unavailable after retries")
+    raise RuntimeError(f"jax backend init failed permanently: {last}")
 
 
 def build_cluster():
@@ -91,19 +185,10 @@ def build_requests(m):
     return shapes
 
 
-def main() -> None:
-    t_setup = time.time()
-    repo = os.path.dirname(os.path.abspath(__file__))
-    import nomad_tpu
-
-    nomad_tpu.enable_compilation_cache(os.path.join(repo, ".jax_cache_tpu"))
-
-    import jax
-
+def bench_kernel(result: dict) -> None:
     from nomad_tpu.ops.kernels import score_batch
     from nomad_tpu.parallel import build_batch_inputs
 
-    platform = jax.devices()[0].platform
     m = build_cluster()
     shapes = build_requests(m)
     arrays = m.sync()
@@ -134,23 +219,174 @@ def main() -> None:
     total = time.time() - t0
 
     evals = DISPATCHES * BATCH
-    throughput = evals / total
     arr = np.array(times)
-    p99_ms = float(np.percentile(arr, 99) * 1000.0)
+    result.update(
+        value=round(evals / total, 1),
+        p99_ms=round(float(np.percentile(arr, 99) * 1000.0), 3),
+        max_ms=round(float(arr.max()) * 1000.0, 3),
+        vs_baseline=round(evals / total / 50000.0, 3),
+        batch=BATCH,
+        nodes=N_NODES,
+        sim_allocs=N_ALLOCS,
+        placed_in_first_batch=placed,
+        dispatches=DISPATCHES,
+    )
+
+
+def bench_e2e(result: dict) -> None:
+    """Drive evals through the LIVE server loop on the same-scale cluster:
+    broker dequeue → worker snapshot-sync → scheduler stack (kernel select
+    per placement) → plan queue → serialized applier verify/commit."""
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    cfg = ServerConfig(
+        num_workers=E2E_WORKERS,
+        node_capacity=CAPACITY,
+        heartbeat_min_ttl=3600.0,
+        heartbeat_max_ttl=7200.0,
+    )
+    srv = Server(cfg)
+    srv.start()
+    try:
+        _run_e2e(srv, result)
+    finally:
+        srv.shutdown()
+
+
+def _run_e2e(srv, result: dict) -> None:
+    from nomad_tpu import mock
+
+    # 10K TTL timers would mean 10K timer threads; the bench isn't about
+    # failure detection, so disarm heartbeats before mass registration.
+    srv.heartbeater.set_enabled(False)
+    rng = np.random.default_rng(7)
+    for i in range(N_NODES):
+        node = mock.node()
+        node.datacenter = "dc1"
+        node.node_class = f"class-{i % 6}"
+        node.attributes = dict(node.attributes)
+        node.attributes["rack"] = f"r{i % 32}"
+        srv.register_node(node)
+    # Pre-load usage so binpack sees a non-trivial cluster.
+    host = srv.matrix.snapshot_host()
+    usage = rng.uniform(0.1, 0.6, (N_NODES, 3)) * host["totals"][:N_NODES]
+    host["used"][:N_NODES] = usage
+    srv.matrix._dirty.update(range(N_NODES))
+
+    def make_job(i: int):
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = E2E_GROUP_COUNT
+        tg.tasks[0].resources.cpu = 50 + 25 * (i % 4)
+        tg.tasks[0].resources.memory_mb = 64 + 32 * (i % 3)
+        return job
+
+    # Warm the select path (first kernel compile) outside the timed region.
+    ev = srv.submit_job(make_job(0))
+    srv.wait_for_eval(ev.id, timeout=120.0)
+
+    # Throughput: a burst of jobs, wall-clock until every eval terminal.
+    evals = []
+    t0 = time.time()
+    for i in range(E2E_JOBS):
+        evals.append(srv.submit_job(make_job(i)))
+    deadline = time.time() + 300.0
+    pending = {e.id for e in evals}
+    while pending and time.time() < deadline:
+        done = set()
+        for eid in pending:
+            e = srv.store.eval_by_id(eid)
+            if e is not None and e.terminal_status():
+                done.add(eid)
+        pending -= done
+        if pending:
+            # Coarse poll: latency is measured by the probe phase below;
+            # a fine poll here would contend with the workers' store locks
+            # and depress the throughput being measured.
+            time.sleep(0.01)
+    t_burst = time.time() - t0
+    completed = E2E_JOBS - len(pending)
+
+    # Latency: sequential probes with a fine-grained poll (0.25ms).
+    # Timed-out probes are excluded from the percentiles (they'd be
+    # censored 10s artifacts, not completions) and disclosed separately;
+    # two consecutive timeouts abort the phase — the condition persists.
+    lat = []
+    timeouts = 0
+    consecutive_timeouts = 0
+    for i in range(E2E_PROBES):
+        t = time.time()
+        e = srv.submit_job(make_job(i))
+        timed_out = False
+        while True:
+            cur = srv.store.eval_by_id(e.id)
+            if cur is not None and cur.terminal_status():
+                break
+            if time.time() - t > 10.0:
+                timed_out = True
+                break
+            time.sleep(0.00025)
+        if timed_out:
+            timeouts += 1
+            consecutive_timeouts += 1
+            if consecutive_timeouts >= 2:
+                break
+        else:
+            consecutive_timeouts = 0
+            lat.append(time.time() - t)
+
+    result.update(
+        e2e_evals_per_sec=round(completed / t_burst, 1),
+        e2e_completed=completed,
+        e2e_jobs=E2E_JOBS,
+        e2e_placements_per_eval=E2E_GROUP_COUNT,
+        e2e_workers=E2E_WORKERS,
+    )
+    if timeouts:
+        result["e2e_probe_timeouts"] = timeouts
+    if lat:
+        arr = np.array(lat)
+        result.update(
+            e2e_p50_ms=round(float(np.percentile(arr, 50) * 1000.0), 3),
+            e2e_p99_ms=round(float(np.percentile(arr, 99) * 1000.0), 3),
+        )
+
+
+def main() -> None:
+    t_setup = time.time()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    import nomad_tpu
+
+    nomad_tpu.enable_compilation_cache(os.path.join(repo, ".jax_cache_tpu"))
+
+    platform = init_backend()
+    global DISPATCHES, E2E_JOBS, E2E_PROBES
+    if platform == "cpu" and "BENCH_DISPATCHES" not in os.environ:
+        # CPU fallback: keep runtime bounded; the number is still honest
+        # (platform is disclosed in the output).
+        DISPATCHES = 30
+    if platform == "cpu" and "BENCH_E2E_JOBS" not in os.environ:
+        E2E_JOBS = 64
+    if platform == "cpu" and "BENCH_E2E_PROBES" not in os.environ:
+        E2E_PROBES = 20
+
     result = {
         "metric": "eval_throughput",
-        "value": round(throughput, 1),
+        "value": 0.0,
         "unit": "evals/sec",
-        "vs_baseline": round(throughput / 50000.0, 3),
-        "p99_ms": round(p99_ms, 3),
-        "max_ms": round(float(arr.max()) * 1000.0, 3),
-        "batch": BATCH,
-        "nodes": N_NODES,
-        "sim_allocs": N_ALLOCS,
-        "placed_in_first_batch": placed,
+        "vs_baseline": 0.0,
         "platform": platform,
-        "setup_s": round(time.time() - t_setup, 1),
     }
+    bench_kernel(result)
+    if E2E:
+        try:
+            bench_e2e(result)
+        except Exception as e:  # noqa: BLE001 — never lose the kernel number
+            import traceback
+
+            traceback.print_exc()
+            result["e2e_error"] = f"{type(e).__name__}: {e}"
+    result["setup_s"] = round(time.time() - t_setup, 1)
     print(json.dumps(result))
 
 
