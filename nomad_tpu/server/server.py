@@ -53,6 +53,12 @@ class ServerConfig:
     heartbeat_max_ttl: float = 20.0
     failed_eval_unblock_delay: float = 60.0
     node_capacity: int = 1024
+    # Durability (fsm.go Persist/Restore + raft-boltdb log): when set, every
+    # state mutation is write-ahead journaled under data_dir and the server
+    # restores snapshot+log on boot. None = in-memory only (tests/sim).
+    data_dir: Optional[str] = None
+    wal_fsync: bool = False
+    snapshot_every: int = 4096
     scheduler_config: SchedulerConfiguration = field(
         default_factory=SchedulerConfiguration
     )
@@ -64,6 +70,18 @@ class Server:
         self.matrix = NodeMatrix(capacity=self.config.node_capacity)
         self.store = StateStore(matrix=self.matrix)
         self.store.scheduler_config = self.config.scheduler_config
+        if self.config.data_dir:
+            from ..state.wal import WriteAheadLog
+
+            wal = WriteAheadLog(self.config.data_dir, fsync=self.config.wal_fsync)
+            snap, entries = wal.load()
+            if snap or entries:
+                log.info(
+                    "restoring state: snapshot=%s wal_entries=%d",
+                    bool(snap), len(entries),
+                )
+            self.store.restore(snap, entries)
+            self.store.attach_wal(wal, snapshot_every=self.config.snapshot_every)
 
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.eval_nack_timeout,
@@ -147,6 +165,14 @@ class Server:
         self.eval_broker.shutdown()
         self.plan_queue.shutdown()
         self.heartbeater.set_enabled(False)
+        if self.store.wal is not None:
+            # Clean-shutdown snapshot: compacts the log and speeds the next
+            # boot (crash-stop restores identically from WAL replay).
+            try:
+                self.store.write_snapshot()
+                self.store.wal.close()
+            except Exception:  # noqa: BLE001
+                log.exception("shutdown snapshot failed")
 
     def _restore_evals(self) -> None:
         """Re-enqueue non-terminal evals from state on leadership gain

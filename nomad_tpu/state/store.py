@@ -30,6 +30,7 @@ The store also forwards node/alloc deltas to the device-resident
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
@@ -49,6 +50,47 @@ from ..structs.types import (
     SchedulerConfiguration,
 )
 from .matrix import NodeMatrix
+
+
+def journaled(fn):
+    """Journal a top-level store mutation to the attached WAL (if any).
+
+    The append happens *before* the mutation applies (write-ahead), inside
+    the store lock so the log order is the apply order.  Nested mutator
+    calls (``upsert_plan_results`` → ``upsert_allocs``…) and replayed
+    mutations are not re-journaled.
+    """
+    op = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self, index, *args, **kwargs):
+        with self._lock:
+            if (
+                self.wal is None
+                or self._replaying
+                or self._journal_depth > 0
+            ):
+                return fn(self, index, *args, **kwargs)
+            from ..structs import serde
+
+            self.wal.append(
+                index,
+                op,
+                {
+                    "args": [serde.to_wire(a) for a in args],
+                    "kwargs": {k: serde.to_wire(v) for k, v in kwargs.items()},
+                },
+            )
+            self._journal_depth += 1
+            try:
+                out = fn(self, index, *args, **kwargs)
+            finally:
+                self._journal_depth -= 1
+            if self.wal.appends_since_snapshot >= self.snapshot_every:
+                self.write_snapshot()
+            return out
+
+    return wrapper
 
 
 class JobSummary:
@@ -79,6 +121,13 @@ class StateStore:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self.matrix = matrix if matrix is not None else NodeMatrix()
+
+        # Durability seam (attach_wal): top-level mutations journal through
+        # the @journaled decorator; replay suppresses re-journaling.
+        self.wal = None
+        self._replaying = False
+        self._journal_depth = 0
+        self.snapshot_every = 4096
 
         self.latest_index = 0
         self._table_index: Dict[str, int] = {}
@@ -141,6 +190,7 @@ class StateStore:
     # Nodes
     # ------------------------------------------------------------------
 
+    @journaled
     def upsert_node(self, index: int, node: Node) -> None:
         with self._lock:
             prev = self.nodes.get(node.id)
@@ -153,12 +203,14 @@ class StateStore:
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
 
+    @journaled
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             if self.nodes.pop(node_id, None) is not None:
                 self.matrix.remove_node(node_id)
                 self._bump("nodes", index)
 
+    @journaled
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
         with self._lock:
             prev = self.nodes.get(node_id)
@@ -174,6 +226,7 @@ class StateStore:
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
 
+    @journaled
     def update_node_eligibility(
         self, index: int, node_id: str, eligibility: str
     ) -> None:
@@ -190,6 +243,7 @@ class StateStore:
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
 
+    @journaled
     def update_node_drain(
         self, index: int, node_id: str, drain_strategy, mark_eligible: bool = False
     ) -> None:
@@ -228,6 +282,7 @@ class StateStore:
     # Jobs
     # ------------------------------------------------------------------
 
+    @journaled
     def upsert_job(self, index: int, job: Job) -> None:
         with self._lock:
             key = (job.namespace, job.id)
@@ -274,6 +329,7 @@ class StateStore:
             bx.pop(k, None)
         return ax != bx
 
+    @journaled
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
             key = (namespace, job_id)
@@ -302,6 +358,7 @@ class StateStore:
     # Evaluations
     # ------------------------------------------------------------------
 
+    @journaled
     def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
         with self._lock:
             for ev in evals:
@@ -317,6 +374,7 @@ class StateStore:
                 )
             self._bump("evals", index)
 
+    @journaled
     def delete_eval(self, index: int, eval_id: str) -> None:
         with self._lock:
             ev = self.evals.pop(eval_id, None)
@@ -356,6 +414,7 @@ class StateStore:
         if s:
             s.discard(alloc.id)
 
+    @journaled
     def upsert_allocs(self, index: int, allocs: Iterable[Allocation]) -> None:
         """Insert/replace allocations, keeping the device matrix in sync."""
         with self._lock:
@@ -400,6 +459,7 @@ class StateStore:
                         self.allocs[old2.id] = old2
             self._bump("allocs", index)
 
+    @journaled
     def update_allocs_from_client(
         self, index: int, updates: Iterable[Allocation]
     ) -> None:
@@ -422,6 +482,7 @@ class StateStore:
             if merged:
                 self.upsert_allocs(index, merged)
 
+    @journaled
     def delete_alloc(self, index: int, alloc_id: str) -> None:
         with self._lock:
             alloc = self.allocs.pop(alloc_id, None)
@@ -484,6 +545,7 @@ class StateStore:
     # Deployments
     # ------------------------------------------------------------------
 
+    @journaled
     def upsert_deployment(self, index: int, deployment: Deployment) -> None:
         with self._lock:
             prev = self.deployments.get(deployment.id)
@@ -498,6 +560,7 @@ class StateStore:
             ).add(deployment.id)
             self._bump("deployment", index)
 
+    @journaled
     def delete_deployment(self, index: int, deployment_id: str) -> None:
         with self._lock:
             d = self.deployments.pop(deployment_id, None)
@@ -525,6 +588,7 @@ class StateStore:
     # Scheduler config (raft-held runtime knobs; structs/operator.go)
     # ------------------------------------------------------------------
 
+    @journaled
     def set_scheduler_config(self, index: int, config: SchedulerConfiguration) -> None:
         with self._lock:
             self.scheduler_config = config
@@ -534,6 +598,7 @@ class StateStore:
     # Plan results (UpsertPlanResults, state_store.go:318)
     # ------------------------------------------------------------------
 
+    @journaled
     def upsert_plan_results(
         self,
         index: int,
@@ -559,6 +624,108 @@ class StateStore:
             self.upsert_allocs(index, stops + preemptions + allocs)
             if evals:
                 self.upsert_evals(index, evals)
+
+
+    # ------------------------------------------------------------------
+    # Durability: WAL attach, snapshot image, restore
+    # (reference: nomad/fsm.go:1367 Persist / :1381 Restore)
+    # ------------------------------------------------------------------
+
+    def attach_wal(self, wal, snapshot_every: int = 4096) -> None:
+        """Start journaling top-level mutations to ``wal``.  Call after
+        :meth:`restore` so replayed mutations are not re-appended."""
+        with self._lock:
+            self.wal = wal
+            self.snapshot_every = snapshot_every
+
+    def to_snapshot_wire(self) -> dict:
+        """Serialize the full FSM image (matrix excluded — it is rebuilt by
+        replaying restores through the mutators)."""
+        from ..structs import serde
+
+        with self._lock:
+            return {
+                "latest_index": self.latest_index,
+                "table_index": dict(self._table_index),
+                "nodes": [serde.to_wire(n) for n in self.nodes.values()],
+                "job_versions": [
+                    [serde.to_wire(v) for v in versions]
+                    for versions in self.job_versions.values()
+                ],
+                "evals": [serde.to_wire(e) for e in self.evals.values()],
+                "allocs": [serde.to_wire(a) for a in self.allocs.values()],
+                "deployments": [
+                    serde.to_wire(d) for d in self.deployments.values()
+                ],
+                "periodic_launch": [
+                    [ns, jid, t]
+                    for (ns, jid), t in self.periodic_launch.items()
+                ],
+                "scheduler_config": serde.to_wire(self.scheduler_config),
+            }
+
+    def write_snapshot(self) -> None:
+        if self.wal is not None:
+            self.wal.write_snapshot(self.to_snapshot_wire())
+
+    def restore(self, snapshot_wire: Optional[dict], entries: List[dict]) -> None:
+        """Rebuild state (and, via the mutators, the device matrix) from a
+        snapshot image + WAL tail.  Must run before :meth:`attach_wal`."""
+        from ..structs import serde
+
+        with self._lock:
+            self._replaying = True
+            try:
+                if snapshot_wire:
+                    self._restore_snapshot(snapshot_wire, serde)
+                for e in entries:
+                    args = [serde.from_wire(a) for a in e["a"]["args"]]
+                    kwargs = {
+                        k: serde.from_wire(v)
+                        for k, v in e["a"]["kwargs"].items()
+                    }
+                    getattr(self, e["op"])(e["i"], *args, **kwargs)
+            finally:
+                self._replaying = False
+
+    def _restore_snapshot(self, snap: dict, serde) -> None:
+        # Replay through the mutators so derived state (matrix rows, alloc
+        # usage aggregates, secondary indexes, summaries) rebuilds itself;
+        # then patch the index/version fields the mutators recompute.
+        for w in snap["nodes"]:
+            node = serde.from_wire(w)
+            create = node.create_index
+            self.upsert_node(node.modify_index, node)
+            node.create_index = create
+        for versions_w in snap["job_versions"]:
+            versions = [serde.from_wire(w) for w in versions_w]
+            for v in versions:
+                wanted_version = v.version
+                create = v.create_index
+                self.upsert_job(v.modify_index, v)
+                v.version = wanted_version
+                v.create_index = create
+        for w in snap["evals"]:
+            ev = serde.from_wire(w)
+            create = ev.create_index
+            self.upsert_evals(ev.modify_index, [ev])
+            ev.create_index = create
+        for w in snap["allocs"]:
+            alloc = serde.from_wire(w)
+            create = alloc.create_index
+            self.upsert_allocs(alloc.modify_index, [alloc])
+            alloc.create_index = create
+        for w in snap["deployments"]:
+            dep = serde.from_wire(w)
+            create = dep.create_index
+            self.upsert_deployment(dep.modify_index, dep)
+            dep.create_index = create
+        for ns, jid, t in snap["periodic_launch"]:
+            self.periodic_launch[(ns, jid)] = t
+        self.scheduler_config = serde.from_wire(snap["scheduler_config"])
+        # Exact index fidelity last — replays bumped these monotonically.
+        self.latest_index = snap["latest_index"]
+        self._table_index = dict(snap["table_index"])
 
 
 class StateSnapshot:

@@ -1,0 +1,133 @@
+"""Write-ahead log + snapshots — the durability half of the Raft seam.
+
+The reference persists every state mutation twice over: the Raft log
+(BoltDB, ``raft-boltdb``) and periodic FSM snapshots
+(``nomad/fsm.go:1367`` Persist / ``:1381`` Restore, 2 retained,
+``nomad/server.go:64``).  A server restart replays snapshot + log tail and
+the leader rebuilds in-memory services (broker, periodic) from state
+(``nomad/leader.go:493``).
+
+This build is a single-voter deployment of the same discipline:
+
+- Every **top-level** store mutation is appended to ``wal.jsonl`` as
+  ``{"i": index, "op": method, "a": wire-args}`` *before* it is applied
+  (write-ahead).  Nested mutations (e.g. ``upsert_plan_results`` calling
+  ``upsert_allocs``) are not journaled — replaying the outer op re-executes
+  them deterministically.
+- ``write_snapshot`` atomically persists the full store image
+  (tmp + rename), then rotates the log.  Entries with ``index <=`` the
+  snapshot index are skipped at load, so a crash between snapshot and
+  rotation cannot double-apply.
+- The device ``NodeMatrix`` is NOT persisted: restore replays mutations
+  through the store, whose mutators feed the matrix incrementally — the
+  HBM image is rebuilt as a side effect (SURVEY.md §7 hard-part a).
+
+The multi-voter upgrade path keeps this file: a replicated log would agree
+on the entry sequence first, then feed the same ``(index, op, args)``
+records to the same apply path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator, List, Optional, Tuple
+
+LOG_NAME = "wal.jsonl"
+SNAPSHOT_NAME = "snapshot.json"
+
+
+class WriteAheadLog:
+    """Append-only JSONL log + atomic snapshot files in ``data_dir``.
+
+    ``fsync`` controls whether every append reaches the platter before the
+    mutation applies (durable but slow); with ``fsync=False`` appends are
+    flushed to the OS (surviving process crash, not host crash).
+    """
+
+    def __init__(self, data_dir: str, fsync: bool = False):
+        self.data_dir = data_dir
+        self.fsync = fsync
+        os.makedirs(data_dir, exist_ok=True)
+        self.log_path = os.path.join(data_dir, LOG_NAME)
+        self.snapshot_path = os.path.join(data_dir, SNAPSHOT_NAME)
+        self._fh = None
+        self.appends_since_snapshot = 0
+
+    # ------------------------------------------------------------------
+    # Load (restore path)
+    # ------------------------------------------------------------------
+
+    def load(self) -> Tuple[Optional[dict], List[dict]]:
+        """Return (snapshot wire dict or None, log entries past it).
+
+        Corrupt trailing lines (torn final write from a crash) are
+        discarded; corruption in the middle raises.
+        """
+        snapshot = None
+        snap_index = -1
+        if os.path.exists(self.snapshot_path):
+            with open(self.snapshot_path, "r", encoding="utf-8") as fh:
+                snapshot = json.load(fh)
+            snap_index = snapshot.get("latest_index", -1)
+
+        entries: List[dict] = []
+        if os.path.exists(self.log_path):
+            with open(self.log_path, "r", encoding="utf-8") as fh:
+                lines = fh.readlines()
+            for pos, line in enumerate(lines):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    if pos == len(lines) - 1:
+                        break  # torn final append from a crash — drop it
+                    raise
+                if entry["i"] <= snap_index:
+                    continue  # already folded into the snapshot
+                entries.append(entry)
+        return snapshot, entries
+
+    # ------------------------------------------------------------------
+    # Append (write-ahead path)
+    # ------------------------------------------------------------------
+
+    def _open(self):
+        if self._fh is None:
+            self._fh = open(self.log_path, "a", encoding="utf-8")
+        return self._fh
+
+    def append(self, index: int, op: str, args_wire: Any) -> None:
+        fh = self._open()
+        fh.write(json.dumps({"i": index, "op": op, "a": args_wire}) + "\n")
+        fh.flush()
+        if self.fsync:
+            os.fsync(fh.fileno())
+        self.appends_since_snapshot += 1
+
+    # ------------------------------------------------------------------
+    # Snapshot + log rotation
+    # ------------------------------------------------------------------
+
+    def write_snapshot(self, snapshot_wire: dict) -> None:
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(snapshot_wire, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.snapshot_path)
+        # Rotate the log: everything <= the snapshot index is now redundant
+        # (and skipped at load even if this truncation never happens).
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        with open(self.log_path, "w", encoding="utf-8"):
+            pass
+        self.appends_since_snapshot = 0
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
