@@ -47,6 +47,10 @@ class AllocRunner:
         self._destroyed = False
         self._thread: Optional[threading.Thread] = None
         self._waiters: List[TaskRunner] = []
+        # Deployment health (client/allochealth/tracker.go): set once per
+        # alloc lifetime, reported back via the update batch loop.
+        self.deployment_health: Optional[bool] = None
+        self.deployment_health_at: float = 0.0
 
     # ------------------------------------------------------------------
 
@@ -99,6 +103,15 @@ class AllocRunner:
             tr.start()
             return tr
 
+        # Deployment-health tracking starts with the tasks (alloc_runner
+        # health hook → client/allochealth/tracker.go).
+        if self.alloc.deployment_id:
+            threading.Thread(
+                target=self._health_watch,
+                name=f"health-{self.alloc.id[:8]}",
+                daemon=True,
+            ).start()
+
         for t in prestart:
             tr = launch(t)
             tr.wait()
@@ -117,6 +130,51 @@ class AllocRunner:
             if not self._destroyed:
                 launch(t).wait()
         self._finalize()
+
+    # ------------------------------------------------------------------
+
+    def _health_watch(self) -> None:
+        """Deployment health determination (client/allochealth/tracker.go):
+        healthy once all main tasks run continuously for min_healthy_time;
+        unhealthy on any task failure or when healthy_deadline passes."""
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        update = tg.update if tg else None
+        min_healthy = update.min_healthy_time if update else 10.0
+        deadline = time.time() + (
+            update.healthy_deadline if update else 5 * 60.0
+        )
+        main_names = [t.name for t in self._tasks() if not t.lifecycle_hook]
+        healthy_since: Optional[float] = None
+        poll = max(0.02, min(0.25, min_healthy / 4 if min_healthy else 0.25))
+        while not self._destroyed and self.deployment_health is None:
+            now = time.time()
+            with self._lock:
+                states = dict(self.task_states)
+            if any(s.failed for s in states.values()):
+                self._set_health(False)
+                return
+            running = [
+                n for n in main_names
+                if states.get(n) is not None and states[n].state == "running"
+            ]
+            if len(running) == len(main_names) and main_names:
+                if healthy_since is None:
+                    healthy_since = now
+                elif now - healthy_since >= min_healthy:
+                    self._set_health(True)
+                    return
+            else:
+                healthy_since = None
+            if now > deadline:
+                self._set_health(False)
+                return
+            time.sleep(poll)
+
+    def _set_health(self, healthy: bool) -> None:
+        self.deployment_health = healthy
+        self.deployment_health_at = time.time()
+        self.on_alloc_update(self)
 
     # ------------------------------------------------------------------
 

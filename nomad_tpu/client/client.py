@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 from ..structs.types import (
     AllocClientStatus,
+    AllocDeploymentStatus,
     AllocDesiredStatus,
     Allocation,
     DriverInfo,
@@ -199,6 +200,15 @@ class Client:
                 upd.task_states = {
                     k: v for k, v in ar.task_states.items()
                 }
+                if ar.deployment_health is not None:
+                    # Preserve the server-stamped canary flag; only health
+                    # is client-determined (Node.UpdateAlloc merge).
+                    prev = upd.deployment_status
+                    upd.deployment_status = AllocDeploymentStatus(
+                        healthy=ar.deployment_health,
+                        timestamp=ar.deployment_health_at,
+                        canary=prev.canary if prev is not None else False,
+                    )
                 updates.append(upd)
             if updates:
                 try:

@@ -7,6 +7,7 @@ vectorized kernels on TPU (``nomad_tpu.ops.kernels``); this package is the
 host orchestration around them.
 """
 
+from .core import CoreScheduler
 from .generic import GenericScheduler
 from .system import SystemScheduler
 from .stack import GenericStack, SystemStack
@@ -15,6 +16,7 @@ BUILTIN_SCHEDULERS = {
     "service": lambda *a, **kw: GenericScheduler("service", *a, **kw),
     "batch": lambda *a, **kw: GenericScheduler("batch", *a, **kw),
     "system": lambda *a, **kw: SystemScheduler(*a, **kw),
+    "_core": lambda *a, **kw: CoreScheduler(*a, **kw),
 }
 
 

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from ..structs.types import (
     AllocClientStatus,
+    AllocDeploymentStatus,
     AllocDesiredStatus,
     Allocation,
     AllocMetric,
@@ -117,6 +118,17 @@ class GenericScheduler:
             batch=self.batch,
         )
         results = reconciler.compute()
+        # Placements made while an active same-version deployment is being
+        # driven (next batches, canaries) attach to it (generic_sched.go
+        # computePlacements deploymentID stamping).
+        self._active_deployment = (
+            deployment
+            if deployment is not None
+            and job is not None
+            and deployment.job_version == job.version
+            and deployment.active()
+            else None
+        )
 
         # Follow-up evals must exist before allocs reference them
         # (generic_sched.go createRescheduleLaterEvals ordering).
@@ -262,8 +274,13 @@ class GenericScheduler:
                 )
                 alloc.reschedule_tracker = RescheduleTracker(events=tracker)
                 alloc.desired_description = ALLOC_RESCHEDULED
-        if ctx.plan.deployment is not None:
-            alloc.deployment_id = ctx.plan.deployment.id
+        deploy = ctx.plan.deployment or getattr(
+            self, "_active_deployment", None
+        )
+        if deploy is not None:
+            alloc.deployment_id = deploy.id
+        if place.canary:
+            alloc.deployment_status = AllocDeploymentStatus(canary=True)
 
         if opt.needs_preempt:
             node = opt.node

@@ -79,6 +79,7 @@ class TGReconcileResult:
     inplace: List[UpdateRequest] = field(default_factory=list)
     destructive: List[UpdateRequest] = field(default_factory=list)
     ignore: int = 0
+    placing_canaries: bool = False
     # desired annotation counts (reference: structs.DesiredUpdates)
     desired: Dict[str, int] = field(default_factory=dict)
 
@@ -222,6 +223,23 @@ class AllocReconciler:
         creating_deployment = False
         dstates: Dict[str, DeploymentState] = {}
 
+        # A failed deployment for the CURRENT version blocks further updates
+        # until a new version arrives (reconcile.go deploymentFailed); only
+        # an active same-version deployment continues to be driven.
+        self._deployment = (
+            deployment
+            if deployment is not None and deployment.job_version == job.version
+            else None
+        )
+        self._deployment_failed = (
+            self._deployment is not None
+            and self._deployment.status == DeploymentStatus.FAILED.value
+        )
+        self._deployment_paused = (
+            self._deployment is not None
+            and self._deployment.status == DeploymentStatus.PAUSED.value
+        )
+
         for tg in job.task_groups:
             allocs = by_tg.pop(tg.name, [])
             tg_res = self._compute_group(tg, allocs, res)
@@ -232,14 +250,17 @@ class AllocReconciler:
             res.desired_tg_updates[tg.name] = tg_res.desired
 
             # Deployment bookkeeping: a service job with an update stanza
-            # gets a deployment tracking each changed TG
+            # gets a deployment tracking each changed TG when no deployment
+            # exists yet for this job version
             # (reconcile.go computeDeploymentUpdates).
             if (
                 job.type == JobType.SERVICE.value
                 and tg.update is not None
                 and tg.update.max_parallel > 0
-                and (tg_res.place or tg_res.destructive)
-                and deployment is None
+                and (tg_res.place or tg_res.destructive
+                     or tg_res.placing_canaries)
+                and self._deployment is None
+                and not self._deployment_failed
             ):
                 creating_deployment = True
                 dstates[tg.name] = DeploymentState(
@@ -315,7 +336,11 @@ class AllocReconciler:
             else:
                 live.append(a)
 
-        # -- tainted-node handling: migrate (drain) or lost (down/gone)
+        # -- tainted-node handling: migrate (drain, drainer-paced) or lost
+        # (down/gone).  Draining nodes migrate ONLY the allocs the drainer
+        # has stamped with a migrate DesiredTransition — that is how drain
+        # pacing works (reconcile_util.go filterByTainted +
+        # nomad/drainer/watch_jobs.go batches).
         untainted: List[Allocation] = []
         migrate: List[Allocation] = []
         lost: List[Allocation] = []
@@ -330,9 +355,46 @@ class AllocReconciler:
                 continue
             node = self.tainted[a.node_id]
             if node is not None and node.drain:
-                migrate.append(a)
+                if a.desired_transition.should_migrate():
+                    migrate.append(a)
+                else:
+                    untainted.append(a)
             else:
                 lost.append(a)
+
+        # -- canaries of the current deployment are handled out-of-band of
+        # the name bookkeeping below (reconcile.go cancelUnneededCanaries /
+        # computeCanaries): they shadow existing names until promotion.
+        deployment = self._deployment
+        dstate = (
+            deployment.task_groups.get(tg.name)
+            if deployment is not None
+            else None
+        )
+        promoted = dstate.promoted if dstate is not None else False
+        canaries: List[Allocation] = []
+        if deployment is not None:
+            canaries = [
+                a for a in untainted
+                if a.deployment_id == deployment.id
+                and a.deployment_status is not None
+                and a.deployment_status.canary
+            ]
+            canary_ids = {a.id for a in canaries}
+            untainted = [a for a in untainted if a.id not in canary_ids]
+        if self._deployment_failed and canaries:
+            # Failed deployment: its canaries are torn down (the old
+            # version keeps running; auto-revert is the watcher's job).
+            for a in canaries:
+                out.stop.append(StopRequest(a, ALLOC_NOT_NEEDED))
+                desired_canary_stops = desired.get("stop", 0) + 1
+                desired["stop"] = desired_canary_stops
+            canaries = []
+        if promoted and canaries:
+            # Promoted canaries are ordinary new-version allocs; they win
+            # the name slots, pushing same-name old allocs into excess.
+            untainted = canaries + untainted
+            canaries = []
 
         # -- failed allocs through reschedule policy: now / later / never
         reschedule_now: List[Allocation] = []
@@ -392,11 +454,54 @@ class AllocReconciler:
             out.inplace.append(UpdateRequest(a, job))
             desired["in_place_update"] += 1
 
-        limit = tg.update.max_parallel if tg.update else len(destructive)
-        if limit <= 0:
+        # -- canary gate: destructive changes behind a canary stanza place
+        # canaries first and defer the rolling update until the deployment
+        # watcher promotes (reconcile.go computeCanaries).
+        requires_canaries = (
+            tg.update is not None
+            and tg.update.canary > 0
+            and destructive
+            and not promoted
+        )
+        if requires_canaries:
+            if not (self._deployment_failed or self._deployment_paused):
+                missing = tg.update.canary - len(canaries)
+                for i in range(max(0, missing)):
+                    out.place.append(
+                        PlaceRequest(
+                            name=name_of(i),
+                            task_group=tg,
+                            canary=True,
+                        )
+                    )
+                    desired["canary"] = desired.get("canary", 0) + 1
+                    out.placing_canaries = True
+            for a in destructive:
+                out.ignore += 1
+                desired["ignore"] += 1
+            destructive = []
+
+        # -- rolling-update pacing: max_parallel minus in-flight placements
+        # of the new version that have not yet reported healthy — the
+        # health gate that makes batches wait (reconcile.go
+        # computeDestructiveUpdates + deploymentwatcher next-batch evals).
+        if tg.update is not None and tg.update.max_parallel > 0:
+            in_flight_unhealthy = 0
+            if deployment is not None:
+                in_flight_unhealthy = sum(
+                    1
+                    for a in keep
+                    if a.deployment_id == deployment.id
+                    and (
+                        a.deployment_status is None
+                        or a.deployment_status.healthy is not True
+                    )
+                )
+            limit = max(0, tg.update.max_parallel - in_flight_unhealthy)
+        else:
             limit = len(destructive)
-        # Pace destructive updates: only max_parallel minus in-flight
-        # unhealthy placements per pass (rolling update, reconcile.go).
+        if self._deployment_failed or self._deployment_paused:
+            limit = 0
         for a in destructive[:limit]:
             out.destructive.append(UpdateRequest(a, job))
             desired["destructive_update"] += 1

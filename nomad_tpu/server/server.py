@@ -24,6 +24,7 @@ from ..state.store import StateStore
 from ..structs.types import (
     AllocClientStatus,
     Allocation,
+    DesiredTransition,
     EvalStatus,
     EvalTrigger,
     Evaluation,
@@ -35,8 +36,11 @@ from ..structs.types import (
     SchedulerConfiguration,
 )
 from .blocked_evals import BlockedEvals
+from .deploymentwatcher import DeploymentWatcher
+from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
 from .heartbeat import HeartbeatManager
+from .periodic import PeriodicDispatcher
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .worker import Worker
@@ -59,6 +63,9 @@ class ServerConfig:
     data_dir: Optional[str] = None
     wal_fsync: bool = False
     snapshot_every: int = 4096
+    # Core GC cadence (reference: leader.go schedulePeriodic; intervals are
+    # per-routine there, one shared interval here).
+    core_gc_interval: float = 300.0
     scheduler_config: SchedulerConfiguration = field(
         default_factory=SchedulerConfiguration
     )
@@ -98,9 +105,14 @@ class Server:
             min_ttl=self.config.heartbeat_min_ttl,
             max_ttl=self.config.heartbeat_max_ttl,
         )
+        # Leader services (nomad/leader.go:222 establishLeadership set).
+        self.deployment_watcher = DeploymentWatcher(self)
+        self.drainer = NodeDrainer(self)
+        self.periodic = PeriodicDispatcher(self)
 
         self._index_lock = threading.Lock()
         self._index = 0
+        self._last_gc = time.time()
         self._leader = False
         self._reaper: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
@@ -141,6 +153,9 @@ class Server:
         for node in list(self.store.nodes.values()):
             if node.status != NodeStatus.DOWN.value:
                 self.heartbeater.reset_heartbeat(node.id)
+        self.deployment_watcher.start()
+        self.drainer.start()
+        self.periodic.start()  # restores periodic jobs from state
         self._shutdown.clear()
         self._reaper = threading.Thread(
             target=self._run_reapers, name="leader-reapers", daemon=True
@@ -155,10 +170,16 @@ class Server:
         self.blocked_evals.set_enabled(False)
         self.plan_queue.set_enabled(False)
         self.heartbeater.set_enabled(False)
+        self.deployment_watcher.stop()
+        self.drainer.stop()
+        self.periodic.stop()
 
     def shutdown(self) -> None:
         self._shutdown.set()
         self._leader = False
+        self.deployment_watcher.stop()
+        self.drainer.stop()
+        self.periodic.stop()
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()
@@ -196,6 +217,8 @@ class Server:
         if job.is_periodic() or job.is_parameterized():
             # Periodic/parameterized jobs get no eval at register time —
             # children are dispatched later (job_endpoint.go:245-260).
+            if job.is_periodic() and self._leader:
+                self.periodic.add(job)
             return None
 
         ev = Evaluation(
@@ -224,6 +247,8 @@ class Server:
             stopped.stop = True
             self.store.upsert_job(index, stopped)
         self.blocked_evals.untrack(namespace, job_id)
+        if job.is_periodic():
+            self.periodic.remove(namespace, job_id)
         ev = Evaluation(
             namespace=namespace,
             priority=job.priority,
@@ -448,6 +473,135 @@ class Server:
         return ev
 
     # ------------------------------------------------------------------
+    # Deployment RPCs (nomad/deployment_endpoint.go Promote/Fail/Pause +
+    # Job revert, nomad/job_endpoint.go:1240 Revert)
+    # ------------------------------------------------------------------
+
+    def update_deployment_status(
+        self, deployment_id: str, status: str, description: str = ""
+    ) -> None:
+        self.store.update_deployment_status(
+            self.next_index(), deployment_id, status, description
+        )
+
+    def promote_deployment(
+        self, deployment_id: str, groups: Optional[List[str]] = None
+    ) -> None:
+        """Flip canary groups to promoted and cut an eval so the reconciler
+        begins replacing old-version allocs."""
+        dep = self.store.deployment_by_id(deployment_id)
+        if dep is None:
+            return
+        self.store.update_deployment_promotion(
+            self.next_index(), deployment_id, groups
+        )
+        job = self.store.job_by_id(dep.namespace, dep.job_id)
+        if job is not None:
+            self.apply_eval_updates([
+                Evaluation(
+                    namespace=dep.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=EvalTrigger.DEPLOYMENT_WATCHER.value,
+                    job_id=dep.job_id,
+                    deployment_id=dep.id,
+                    status=EvalStatus.PENDING.value,
+                )
+            ])
+
+    def fail_deployment(self, deployment_id: str, description: str = "") -> None:
+        from ..structs.types import DeploymentStatus
+
+        self.update_deployment_status(
+            deployment_id,
+            DeploymentStatus.FAILED.value,
+            description or "Deployment marked as failed",
+        )
+
+    def revert_job(
+        self, namespace: str, job_id: str, to_version: Optional[int] = None
+    ) -> Optional[Evaluation]:
+        """Re-submit a prior job version as a new version (auto-revert and
+        the `job revert` CLI; nomad/job_endpoint.go:1240)."""
+        current = self.store.job_by_id(namespace, job_id)
+        if current is None:
+            return None
+        versions = self.store.job_versions.get((namespace, job_id), [])
+        target: Optional[Job] = None
+        for v in reversed(versions):
+            if to_version is not None:
+                if v.version == to_version:
+                    target = v
+                    break
+            elif v.version < current.version:
+                target = v
+                break
+        if target is None:
+            return None
+        reverted = target.copy()
+        reverted.stop = False
+        return self.submit_job(reverted)
+
+    # ------------------------------------------------------------------
+    # Drainer + periodic applies
+    # ------------------------------------------------------------------
+
+    def apply_alloc_desired_transitions(
+        self, transitions: Dict[str, "DesiredTransition"], evals: List[Evaluation]
+    ) -> None:
+        """Batched drainer stamp + evals (AllocUpdateDesiredTransition,
+        drainer.go:357)."""
+        self.store.update_allocs_desired_transition(
+            self.next_index(), transitions
+        )
+        if evals:
+            self.apply_eval_updates(evals)
+
+    def complete_node_drain(self, node_id: str) -> None:
+        """Drain finished: clear the strategy, node stays ineligible
+        (drainer.go NodesDrainComplete)."""
+        node = self.store.node_by_id(node_id)
+        if node is None or not node.drain:
+            return
+        self.store.update_node_drain(
+            self.next_index(), node_id, None, mark_eligible=False
+        )
+        log.info("node %s drain complete", node_id)
+
+    def record_periodic_launch(
+        self, namespace: str, job_id: str, launch_time: float
+    ) -> None:
+        self.store.record_periodic_launch(
+            self.next_index(), namespace, job_id, launch_time
+        )
+
+    # ------------------------------------------------------------------
+    # GC applies (core_sched.go deletion raft applies)
+    # ------------------------------------------------------------------
+
+    def apply_gc(
+        self,
+        jobs: Optional[List[Tuple[str, str]]] = None,
+        evals: Optional[List[str]] = None,
+        allocs: Optional[List[str]] = None,
+        deployments: Optional[List[str]] = None,
+        nodes: Optional[List[str]] = None,
+    ) -> None:
+        index = self.next_index()
+        for aid in allocs or []:
+            self.store.delete_alloc(index, aid)
+        for eid in evals or []:
+            self.store.delete_eval(index, eid)
+        for ns, jid in jobs or []:
+            self.store.delete_job(index, ns, jid)
+            self.store.periodic_launch.pop((ns, jid), None)
+        for did in deployments or []:
+            self.store.delete_deployment(index, did)
+        for nid in nodes or []:
+            self.heartbeater.clear_heartbeat(nid)
+            self.store.delete_node(index, nid)
+
+    # ------------------------------------------------------------------
     # Plan-apply hook
     # ------------------------------------------------------------------
 
@@ -493,6 +647,34 @@ class Server:
                 cancelled = dup.copy()
                 cancelled.status = EvalStatus.CANCELLED.value
                 self.store.upsert_evals(self.next_index(), [cancelled])
+            # Periodic core GC evals (leader.go:686 schedulePeriodic →
+            # core_sched.go job names), processed by the CoreScheduler.
+            now = time.time()
+            if now - self._last_gc >= self.config.core_gc_interval:
+                self._last_gc = now
+                from ..scheduler.core import (
+                    CORE_JOB_DEPLOYMENT_GC,
+                    CORE_JOB_EVAL_GC,
+                    CORE_JOB_JOB_GC,
+                    CORE_JOB_NODE_GC,
+                )
+
+                self.apply_eval_updates([
+                    Evaluation(
+                        namespace="-",
+                        priority=100,
+                        type="_core",
+                        triggered_by=EvalTrigger.SCHEDULED.value,
+                        job_id=kind,
+                        status=EvalStatus.PENDING.value,
+                    )
+                    for kind in (
+                        CORE_JOB_EVAL_GC,
+                        CORE_JOB_JOB_GC,
+                        CORE_JOB_DEPLOYMENT_GC,
+                        CORE_JOB_NODE_GC,
+                    )
+                ])
             self._shutdown.wait(0.5)
 
     # ------------------------------------------------------------------

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..structs.types import (
@@ -39,6 +40,7 @@ from ..structs.types import (
     AllocDesiredStatus,
     Allocation,
     Deployment,
+    DesiredTransition,
     EvalStatus,
     Evaluation,
     Job,
@@ -221,7 +223,7 @@ class StateStore:
             node = _copy.copy(prev)
             node.status = status
             node.modify_index = index
-            node.status_updated_at = index  # logical clock; wall time set by caller
+            node.status_updated_at = _time.time()
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
@@ -444,6 +446,7 @@ class StateStore:
                 self.allocs[alloc.id] = alloc
                 self._index_alloc(alloc)
                 self._update_summary(alloc, prev, index)
+                self._deployment_alloc_delta(index, alloc, prev)
 
                 # Stamp the replaced alloc so it is never rescheduled twice
                 # (reference: UpsertAllocs sets NextAllocation on the
@@ -583,6 +586,141 @@ class StateStore:
             if d and (best is None or d.create_index > best.create_index):
                 best = d
         return best
+
+    def active_deployments(self) -> List[Deployment]:
+        return [d for d in self.deployments.values() if d.active()]
+
+    @journaled
+    def update_deployment_status(
+        self, index: int, deployment_id: str, status: str, description: str = ""
+    ) -> None:
+        """UpdateDeploymentStatus (state_store.go): terminal statuses detach
+        the deployment from scheduling."""
+        with self._lock:
+            d = self.deployments.get(deployment_id)
+            if d is None:
+                return
+            import copy as _copy
+
+            d2 = _copy.copy(d)
+            d2.status = status
+            d2.status_description = description
+            d2.modify_index = index
+            self.deployments[deployment_id] = d2
+            self._bump("deployment", index)
+
+    @journaled
+    def update_deployment_promotion(
+        self, index: int, deployment_id: str, groups: Optional[List[str]] = None
+    ) -> None:
+        """UpdateDeploymentPromotion (state_store.go): flip promoted on the
+        given TGs (all canary TGs when groups is None)."""
+        with self._lock:
+            d = self.deployments.get(deployment_id)
+            if d is None:
+                return
+            import copy as _copy
+
+            d2 = _copy.copy(d)
+            d2.task_groups = {
+                name: _copy.copy(st) for name, st in d.task_groups.items()
+            }
+            for name, st in d2.task_groups.items():
+                if groups is not None and name not in groups:
+                    continue
+                if st.desired_canaries > 0:
+                    st.promoted = True
+            d2.status_description = "Deployment is running"
+            d2.modify_index = index
+            self.deployments[deployment_id] = d2
+            self._bump("deployment", index)
+
+    def _deployment_alloc_delta(
+        self, index: int, alloc: Allocation, prev: Optional[Allocation]
+    ) -> None:
+        """Maintain per-TG deployment counters as allocs are placed and
+        report health (updateDeploymentWithAlloc, state_store.go).  Called
+        under the lock from upsert_allocs."""
+        if not alloc.deployment_id:
+            return
+        d = self.deployments.get(alloc.deployment_id)
+        if d is None or not d.active():
+            return
+        st = d.task_groups.get(alloc.task_group)
+        if st is None:
+            return
+        import copy as _copy
+
+        placed_delta = 1 if prev is None else 0
+        healthy_delta = unhealthy_delta = 0
+        prev_h = prev.deployment_status.healthy if (
+            prev is not None and prev.deployment_status is not None
+        ) else None
+        new_h = (
+            alloc.deployment_status.healthy
+            if alloc.deployment_status is not None
+            else None
+        )
+        if prev_h is None and new_h is True:
+            healthy_delta = 1
+        elif prev_h is None and new_h is False:
+            unhealthy_delta = 1
+        if not (placed_delta or healthy_delta or unhealthy_delta):
+            return
+        d2 = _copy.copy(d)
+        d2.task_groups = {
+            name: _copy.copy(s) for name, s in d.task_groups.items()
+        }
+        st2 = d2.task_groups[alloc.task_group]
+        st2.placed_allocs += placed_delta
+        st2.healthy_allocs += healthy_delta
+        st2.unhealthy_allocs += unhealthy_delta
+        if placed_delta and alloc.deployment_status is not None and (
+            alloc.deployment_status.canary
+        ):
+            st2.placed_canaries = list(st2.placed_canaries) + [alloc.id]
+        if healthy_delta:
+            # Health progress extends the progress deadline
+            # (deployment_watcher.go progress tracking).
+            st2.require_progress_by = (
+                _time.time() + st2.progress_deadline
+                if st2.progress_deadline
+                else st2.require_progress_by
+            )
+        d2.modify_index = index
+        self.deployments[d2.id] = d2
+        self._bump("deployment", index)
+
+    @journaled
+    def update_allocs_desired_transition(
+        self, index: int, transitions: Dict[str, "DesiredTransition"]
+    ) -> None:
+        """Batched drainer stamp (AllocUpdateDesiredTransition raft apply,
+        nomad/drainer/drainer.go:357)."""
+        with self._lock:
+            import copy as _copy
+
+            for alloc_id, transition in transitions.items():
+                prev = self.allocs.get(alloc_id)
+                if prev is None or prev.terminal_status():
+                    continue
+                a2 = _copy.copy(prev)
+                a2.desired_transition = transition
+                a2.modify_index = index
+                self.allocs[alloc_id] = a2
+            self._bump("allocs", index)
+
+    # ------------------------------------------------------------------
+    # Periodic launches (periodic_launch table, state_store.go)
+    # ------------------------------------------------------------------
+
+    @journaled
+    def record_periodic_launch(
+        self, index: int, namespace: str, job_id: str, launch_time: float
+    ) -> None:
+        with self._lock:
+            self.periodic_launch[(namespace, job_id)] = launch_time
+            self._bump("periodic_launch", index)
 
     # ------------------------------------------------------------------
     # Scheduler config (raft-held runtime knobs; structs/operator.go)
