@@ -20,6 +20,7 @@ import numpy as np
 
 from ..state.matrix import (
     DEVICE_SLOTS,
+    PORT_BITS,
     NodeMatrix,
     numeric_value,
     priority_bucket,
@@ -43,6 +44,7 @@ MAX_AFFINITIES = 8
 MAX_DATACENTERS = 8
 MAX_SPREADS = 2
 MAX_SPREAD_VALUES = 16
+MAX_STATIC_PORTS = 8
 
 # Kernel op codes.
 OP_EQ = 0
@@ -110,6 +112,11 @@ class SchedRequest(NamedTuple):
     # placement scan cannot stack allocs on one node between host-mask
     # refreshes (DistinctHostsIterator, feasible.go:505).
     distinct_hosts: np.ndarray
+    # Port feasibility (NetworkIndex, structs/network.go:35): requested
+    # static ports (-1 pad; only ports < PORT_BITS encoded — the rest are
+    # host-verified) and the dynamic-port ask count.
+    p_static: np.ndarray  # (P,) i32
+    p_dyn: np.ndarray  # () i32
 
 
 @dataclass
@@ -327,6 +334,23 @@ class RequestEncoder:
             if threshold > 0:
                 preempt_bucket = priority_bucket(threshold)
 
+        # Port asks across group + task networks (stack._assign_ports is the
+        # host-side assignment twin; this is the kernel-side feasibility).
+        p_static = np.full((MAX_STATIC_PORTS,), -1, np.int32)
+        p_dyn = 0
+        pi = 0
+        all_nets = list(tg.networks) + [
+            n for t in tg.tasks for n in t.resources.networks
+        ]
+        for net in all_nets:
+            p_dyn += len(net.dynamic_ports)
+            for port in net.reserved_ports:
+                if 0 <= port < PORT_BITS and pi < MAX_STATIC_PORTS:
+                    p_static[pi] = port
+                    pi += 1
+                # overflow / out-of-bitmap ports are verified host-side at
+                # assignment and again at plan-apply
+
         ask = tg.combined_resources()
         req = SchedRequest(
             ask=np.array([ask.cpu, ask.memory_mb, ask.disk_mb], np.float32),
@@ -354,6 +378,8 @@ class RequestEncoder:
             distinct_hosts=np.bool_(
                 any(c.operand == Op.DISTINCT_HOSTS.value for c in constraints)
             ),
+            p_static=p_static,
+            p_dyn=np.int32(p_dyn),
         )
         return CompiledTaskGroup(
             request=req,

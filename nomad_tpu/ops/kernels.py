@@ -123,6 +123,24 @@ def device_mask(arrays, req: SchedRequest) -> jnp.ndarray:
     return jnp.all(ok, axis=1)
 
 
+def port_mask(arrays, req: SchedRequest) -> jnp.ndarray:
+    """(N,) bool — no requested static port collides with the node's
+    occupied-port bitmap, and the dynamic range has room (the vectorized
+    half of NetworkIndex, structs/network.go:35; exact assignment stays
+    host-side on the chosen node, re-verified at plan apply)."""
+    from ..state.matrix import DYN_PORT_CAPACITY
+
+    p = req.p_static  # (P,)
+    valid = p >= 0
+    word = jnp.maximum(p, 0) >> 5  # (P,)
+    bit = (jnp.maximum(p, 0) & 31).astype(jnp.uint32)
+    words = arrays.port_words[:, word]  # (N, P)
+    taken = (words >> bit[None, :]) & jnp.uint32(1)
+    conflict = jnp.any(valid[None, :] & (taken == 1), axis=1)  # (N,)
+    dyn_ok = arrays.dyn_used + req.p_dyn <= DYN_PORT_CAPACITY
+    return (~conflict) & dyn_ok
+
+
 def feasibility_mask(arrays, req: SchedRequest, class_elig=None, host_mask=None):
     """(N,) bool — eligible ∧ dc ∧ constraints ∧ devices ∧ escaped checks.
 
@@ -135,6 +153,7 @@ def feasibility_mask(arrays, req: SchedRequest, class_elig=None, host_mask=None)
     mask &= datacenter_mask(arrays, req)
     mask &= constraint_mask(arrays, req)
     mask &= device_mask(arrays, req)
+    mask &= port_mask(arrays, req)
     if class_elig is not None:
         cid = jnp.maximum(arrays.class_id, 0)
         mask &= jnp.where(arrays.class_id < 0, False, class_elig[cid])

@@ -104,6 +104,8 @@ _ARRAYS_SPEC = DeviceArrays(
     dev_total=P("node", None),
     dev_used=P("node", None),
     prio_used=P("node", None, None),
+    port_words=P("node", None),
+    dyn_used=P("node"),
 )
 
 # Batched request: every leaf has a leading B axis, replicated over 'node'.
@@ -131,6 +133,8 @@ _REQS_SPEC = SchedRequest(
     s_sum_weights=P("batch"),
     preempt_bucket=P("batch"),
     distinct_hosts=P("batch"),
+    p_static=P("batch", None),
+    p_dyn=P("batch"),
 )
 
 

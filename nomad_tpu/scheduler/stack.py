@@ -43,8 +43,8 @@ from .context import EvalContext
 from .feasible_host import check_constraint_host, check_host_volumes
 
 # Dynamic port range (reference: structs/network.go MinDynamicPort/MaxDynamicPort).
-MIN_DYNAMIC_PORT = 20000
-MAX_DYNAMIC_PORT = 32000
+from ..state.matrix import MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT  # noqa: E402
+# (canonical port-range constants live beside the port bitmap encoding)
 
 # Placement chunk ceiling: bounds the set of lax.scan lengths the jit cache
 # ever sees to {1, 2, 4, 8, 16} (SURVEY.md §7 hard-part e).

@@ -224,6 +224,13 @@ class PlanApplier:
             if nid in failed:
                 continue
 
+            # Port re-verify at commit time (AllocsFit's NetworkIndex,
+            # funcs.go:97-150): two optimistically planned allocs claiming
+            # the same static port on one node must not both commit.
+            if not self._ports_fit(plan, node, nid):
+                failed.add(nid)
+                continue
+
             rows.append(row)
             deltas.append(delta)
             checked.append(nid)
@@ -259,3 +266,31 @@ class PlanApplier:
             if not bool(ok):
                 failed.add(nid)
         return failed
+
+    def _ports_fit(self, plan: Plan, node, nid: str) -> bool:
+        """Exact host-side port check against authoritative state: claimed =
+        live allocs on the node minus this plan's evictions/preemptions/
+        replacements, plus the plan's own placements in sequence."""
+        from ..state.matrix import NodeMatrix
+
+        store = self.server.store
+        removed = {
+            a.id
+            for a in plan.node_update.get(nid, [])
+            + plan.node_preemptions.get(nid, [])
+        }
+        planned = plan.node_allocation[nid]
+        replaced = {a.id for a in planned}
+        used = set(node.reserved.reserved_ports)
+        for existing in store.allocs_by_node(nid):
+            if existing.terminal_status():
+                continue
+            if existing.id in removed or existing.id in replaced:
+                continue
+            used.update(NodeMatrix.ports_of(existing))
+        for a in planned:
+            claimed = NodeMatrix.ports_of(a)
+            if claimed & used:
+                return False
+            used |= claimed
+        return True

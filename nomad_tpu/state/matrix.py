@@ -58,6 +58,17 @@ DEVICE_SLOTS = 8
 PRIORITY_BUCKETS = 16  # job priorities 1..100 bucketed by 100/PRIORITY_BUCKETS
 RESOURCE_DIMS = 3  # cpu, mem, disk
 
+# Port occupancy encoding (NetworkIndex equivalent, structs/network.go:35):
+# one bit per port in [0, PORT_BITS) as uint32 words — matrix columns the
+# kernel reads to mask static-port collisions; ports beyond PORT_BITS are
+# host-checked only (rare). Dynamic allocation draws from
+# [MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT] (structs/network.go port range).
+PORT_WORDS = 1024
+PORT_BITS = PORT_WORDS * 32  # 32768
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 32000
+DYN_PORT_CAPACITY = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+
 
 def stable_hash(value: str) -> int:
     """Stable nonzero 31-bit hash of a string attribute value."""
@@ -226,6 +237,8 @@ class DeviceArrays(NamedTuple):
     dev_total: "jax.Array"  # (N, D) i32
     dev_used: "jax.Array"  # (N, D) i32
     prio_used: "jax.Array"  # (N, P, 3) f32
+    port_words: "jax.Array"  # (N, PORT_WORDS) u32 — occupied-port bitmap
+    dyn_used: "jax.Array"  # (N,) i32 — ports consumed in the dynamic range
 
 
 class NodeMatrix:
@@ -268,6 +281,8 @@ class NodeMatrix:
             "prio_used": np.zeros(
                 (cap, PRIORITY_BUCKETS, RESOURCE_DIMS), np.float32
             ),
+            "port_words": np.zeros((cap, PORT_WORDS), np.uint32),
+            "dyn_used": np.zeros((cap,), np.int32),
         }
 
     def _grow(self, min_cap: int) -> None:
@@ -345,6 +360,12 @@ class NodeMatrix:
                 dev_row[slot] = len(instances)
         a["dev_total"][row] = dev_row
 
+        # Node-reserved ports claim their bits up-front (bits are otherwise
+        # owned by the alloc-delta path, so set-only here).
+        for p in node.reserved.reserved_ports:
+            if 0 <= p < PORT_BITS:
+                a["port_words"][row, p >> 5] |= np.uint32(1 << (p & 31))
+
         self._dirty.add(row)
         return row
 
@@ -374,7 +395,8 @@ class NodeMatrix:
                 self.class_repr.pop(cid, None)
             else:
                 self.class_repr[cid] = replacement
-        for k in ("totals", "used", "dev_total", "dev_used"):
+        for k in ("totals", "used", "dev_total", "dev_used", "port_words",
+                  "dyn_used"):
             self._alloc[k][row] = 0
         self._alloc["eligible"][row] = False
         self._alloc["class_id"][row] = -1
@@ -385,6 +407,37 @@ class NodeMatrix:
     def _usage_of(self, alloc: Allocation) -> np.ndarray:
         r = alloc.resources
         return np.array([r.cpu, r.memory_mb, r.disk_mb], np.float32)
+
+    @staticmethod
+    def ports_of(alloc: Allocation) -> set:
+        """Every port an allocation occupies on its node: assigned (static +
+        dynamic) plus statically reserved in its network asks."""
+        ports = set()
+        for nets in alloc.assigned_ports.values():
+            ports.update(nets.values())
+        for net in alloc.resources.networks:
+            ports.update(net.reserved_ports)
+        return ports
+
+    def _port_delta(self, row: int, alloc: Allocation, claim: bool) -> None:
+        ports = self.ports_of(alloc)
+        if not ports:
+            return
+        words = self._alloc["port_words"]
+        dyn = 0
+        for p in ports:
+            if MIN_DYNAMIC_PORT <= p <= MAX_DYNAMIC_PORT:
+                dyn += 1
+            if not 0 <= p < PORT_BITS:
+                continue  # beyond the bitmap — host-checked only
+            w, b = p >> 5, np.uint32(1 << (p & 31))
+            if claim:
+                words[row, w] |= b
+            else:
+                words[row, w] &= ~b
+        if dyn:
+            cur = int(self._alloc["dyn_used"][row])
+            self._alloc["dyn_used"][row] = max(0, cur + (dyn if claim else -dyn))
 
     def add_alloc(self, alloc: Allocation) -> None:
         """Account a (non-terminal) allocation's usage on its node."""
@@ -398,6 +451,7 @@ class NodeMatrix:
             slot = self.devices.register(dev.name)
             if slot is not None:
                 self._alloc["dev_used"][row, slot] += dev.count
+        self._port_delta(row, alloc, claim=True)
         self._dirty.add(row)
 
     def remove_alloc(self, alloc: Allocation) -> None:
@@ -416,6 +470,7 @@ class NodeMatrix:
                 self._alloc["dev_used"][row, slot] = max(
                     0, self._alloc["dev_used"][row, slot] - dev.count
                 )
+        self._port_delta(row, alloc, claim=False)
         self._dirty.add(row)
 
     # -- device sync --------------------------------------------------------
@@ -436,18 +491,11 @@ class NodeMatrix:
     def _sync_locked(self) -> DeviceArrays:
         import jax.numpy as jnp
 
+        # Host array keys match DeviceArrays field names 1:1, so both the
+        # full upload and the dirty-row scatter are field-generic.
         if self._device is None or not self._device_valid:
             self._device = DeviceArrays(
-                totals=jnp.asarray(self._alloc["totals"]),
-                used=jnp.asarray(self._alloc["used"]),
-                eligible=jnp.asarray(self._alloc["eligible"]),
-                attr_hash=jnp.asarray(self._alloc["attr_hash"]),
-                attr_num=jnp.asarray(self._alloc["attr_num"]),
-                attr_ver=jnp.asarray(self._alloc["attr_ver"]),
-                class_id=jnp.asarray(self._alloc["class_id"]),
-                dev_total=jnp.asarray(self._alloc["dev_total"]),
-                dev_used=jnp.asarray(self._alloc["dev_used"]),
-                prio_used=jnp.asarray(self._alloc["prio_used"]),
+                **{f: jnp.asarray(self._alloc[f]) for f in DeviceArrays._fields}
             )
             self._device_valid = True
             self._dirty.clear()
@@ -458,32 +506,12 @@ class NodeMatrix:
             idx = jnp.asarray(rows)
             d = self._device
             self._device = DeviceArrays(
-                totals=d.totals.at[idx].set(jnp.asarray(self._alloc["totals"][rows])),
-                used=d.used.at[idx].set(jnp.asarray(self._alloc["used"][rows])),
-                eligible=d.eligible.at[idx].set(
-                    jnp.asarray(self._alloc["eligible"][rows])
-                ),
-                attr_hash=d.attr_hash.at[idx].set(
-                    jnp.asarray(self._alloc["attr_hash"][rows])
-                ),
-                attr_num=d.attr_num.at[idx].set(
-                    jnp.asarray(self._alloc["attr_num"][rows])
-                ),
-                attr_ver=d.attr_ver.at[idx].set(
-                    jnp.asarray(self._alloc["attr_ver"][rows])
-                ),
-                class_id=d.class_id.at[idx].set(
-                    jnp.asarray(self._alloc["class_id"][rows])
-                ),
-                dev_total=d.dev_total.at[idx].set(
-                    jnp.asarray(self._alloc["dev_total"][rows])
-                ),
-                dev_used=d.dev_used.at[idx].set(
-                    jnp.asarray(self._alloc["dev_used"][rows])
-                ),
-                prio_used=d.prio_used.at[idx].set(
-                    jnp.asarray(self._alloc["prio_used"][rows])
-                ),
+                **{
+                    f: getattr(d, f).at[idx].set(
+                        jnp.asarray(self._alloc[f][rows])
+                    )
+                    for f in DeviceArrays._fields
+                }
             )
             self._dirty.clear()
         return self._device
