@@ -101,8 +101,12 @@ class Agent:
                         w.evals_processed for w in s.workers
                     ),
                     "nomad.heartbeat.active": s.heartbeater.tracked(),
+                    "nomad.stream.subscribers":
+                        s.store.events.subscriber_count(),
                 }
             )
+            # Latency timers (worker.go:245, plan_apply.go:185,370 analogs).
+            out.update(s.metrics.snapshot())
         if self.client is not None:
             out["client.allocs_running"] = self.client.num_allocs()
         return out

@@ -65,9 +65,12 @@ class HTTPAPIServer:
             def _handle(self, method: str) -> None:
                 try:
                     parsed = urlparse(self.path)
-                    query = {
-                        k: v[0] for k, v in parse_qs(parsed.query).items()
-                    }
+                    multi = parse_qs(parsed.query)
+                    query = {k: v[0] for k, v in multi.items()}
+                    if parsed.path == "/v1/event/stream" and method == "GET":
+                        # NDJSON stream — bypasses the one-shot JSON path.
+                        api.stream_events(self, multi)
+                        return
                     length = int(self.headers.get("Content-Length", 0) or 0)
                     raw = self.rfile.read(length) if length else b""
                     body = json.loads(raw) if raw else None
@@ -105,6 +108,48 @@ class HTTPAPIServer:
     def shutdown(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    # ------------------------------------------------------------------
+    # Event stream (nomad/stream/ + /v1/event/stream NDJSON,
+    # command/agent/event_endpoint.go)
+    # ------------------------------------------------------------------
+
+    def stream_events(self, handler, multi_query: Dict) -> None:
+        server = self.agent.server
+        if server is None:
+            raise HTTPError(501, "agent is not running a server")
+        # topic filters: repeated topic=Topic:key params ("*" wildcards).
+        topics: Dict[str, list] = {}
+        for spec in multi_query.get("topic", ["*:*"]):
+            topic, _, key = spec.partition(":")
+            topics.setdefault(topic or "*", []).append(key or "*")
+        from_index = int(multi_query.get("index", ["0"])[0] or 0)
+
+        sub = server.store.events.subscribe(topics, from_index=from_index)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            while True:
+                events = sub.next(timeout=10.0)
+                if sub.closed:
+                    return
+                if not events:
+                    # Heartbeat keeps intermediaries from timing the
+                    # connection out (the reference sends empty objects).
+                    handler.wfile.write(b"{}\n")
+                    handler.wfile.flush()
+                    continue
+                for ev in events:
+                    handler.wfile.write(
+                        (json.dumps(ev.to_wire()) + "\n").encode()
+                    )
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away
+        finally:
+            sub.close()
 
     # ------------------------------------------------------------------
     # Routing (http.go:252-324)

@@ -1,0 +1,96 @@
+"""Latency timers + counters (reference: armon/go-metrics usage —
+``nomad.worker.invoke_scheduler`` worker.go:245, ``nomad.plan.evaluate`` /
+``nomad.plan.apply`` plan_apply.go:185,370, surfaced at ``/v1/metrics``).
+
+A ``Timer`` keeps cheap streaming aggregates (count/sum/min/max) plus a
+bounded reservoir for percentiles — enough for the p99-latency SLO the
+BASELINE tracks, without a dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List
+
+
+class Timer:
+    def __init__(self, reservoir: int = 1024):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self._samples: deque = deque(maxlen=reservoir)
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += seconds
+            if seconds < self.min:
+                self.min = seconds
+            if seconds > self.max:
+                self.max = seconds
+            self._samples.append(seconds)
+
+    @contextmanager
+    def time(self):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.observe(time.time() - t0)
+
+    def _percentile(self, sorted_samples: List[float], q: float) -> float:
+        if not sorted_samples:
+            return 0.0
+        idx = min(len(sorted_samples) - 1, int(q * len(sorted_samples)))
+        return sorted_samples[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self.count, self.sum
+            mn = self.min if self.count else 0.0
+            mx = self.max
+        return {
+            "count": count,
+            "mean_ms": round(total / count * 1000.0, 3) if count else 0.0,
+            "min_ms": round(mn * 1000.0, 3),
+            "max_ms": round(mx * 1000.0, 3),
+            "p50_ms": round(self._percentile(samples, 0.50) * 1000.0, 3),
+            "p95_ms": round(self._percentile(samples, 0.95) * 1000.0, 3),
+            "p99_ms": round(self._percentile(samples, 0.99) * 1000.0, 3),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._timers: Dict[str, Timer] = {}
+        self._counters: Dict[str, int] = {}
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = Timer()
+                self._timers[name] = t
+            return t
+
+    def incr(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            timers = dict(self._timers)
+            counters = dict(self._counters)
+        out: Dict = {}
+        for name, value in counters.items():
+            out[name] = value
+        for name, t in timers.items():
+            out[name] = t.snapshot()
+        return out

@@ -87,14 +87,16 @@ class PlanApplier:
                     f"plan for eval {plan.eval_id} has a stale token"
                 )
         store = self.server.store
-        with store._lock:
-            result, index = self._apply_locked(plan)
+        with self.server.metrics.timer("nomad.plan.apply").time():
+            with store._lock:
+                result, index = self._apply_locked(plan)
         if index:
             self.server.on_plan_applied(plan, result, index)
         return result
 
     def _apply_locked(self, plan: Plan):
-        failed_nodes = self._evaluate(plan)
+        with self.server.metrics.timer("nomad.plan.evaluate").time():
+            failed_nodes = self._evaluate(plan)
         committed_allocs: Dict[str, List[Allocation]] = {
             nid: allocs
             for nid, allocs in plan.node_allocation.items()

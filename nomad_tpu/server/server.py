@@ -74,6 +74,9 @@ class ServerConfig:
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None):
         self.config = config or ServerConfig()
+        from ..metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
         self.matrix = NodeMatrix(capacity=self.config.node_capacity)
         self.store = StateStore(matrix=self.matrix)
         self.store.scheduler_config = self.config.scheduler_config
@@ -266,6 +269,9 @@ class Server:
 
     def apply_eval_updates(self, evals: List[Evaluation]) -> int:
         index = self.next_index()
+        for ev in evals:
+            if not ev.create_time:
+                ev.create_time = time.time()
         self.store.upsert_evals(index, evals)
         for ev in evals:
             if ev.should_enqueue():

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from ..scheduler import new_scheduler
@@ -88,14 +89,23 @@ class Worker:
         # their plans so the applier can reject a worker whose delivery was
         # nack-timeout-redelivered mid-schedule (eval_token, worker.go:74).
         ev.leader_ack = token
+        metrics = self.server.metrics
         # ★ sync point: local replica must reach the eval's creation index
         # before scheduling (worker.go:121, snapshotMinIndex).
-        self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
+        with metrics.timer("nomad.worker.wait_for_index").time():
+            self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
         self._snapshot = self.server.store.snapshot()
         sched = new_scheduler(
             ev.type, self._snapshot, self, self.server.store.matrix
         )
-        sched.process(ev)
+        # invoke_scheduler timer (worker.go:245) — the per-eval hot path.
+        with metrics.timer("nomad.worker.invoke_scheduler").time():
+            sched.process(ev)
+        if ev.create_time:
+            # Enqueue→scheduled end-to-end latency (eval_broker telemetry).
+            metrics.timer("nomad.eval.latency").observe(
+                max(0.0, time.time() - ev.create_time)
+            )
 
     # ------------------------------------------------------------------
     # Planner interface (scheduler/scheduler.go:112; worker.go:277-330)

@@ -131,6 +131,12 @@ class StateStore:
         self._journal_depth = 0
         self.snapshot_every = 4096
 
+        # Change-event stream (nomad/stream/EventBroker): mutators publish
+        # as they commit; restore replay does not re-publish history.
+        from ..stream import EventBroker
+
+        self.events = EventBroker()
+
         self.latest_index = 0
         self._table_index: Dict[str, int] = {}
 
@@ -188,6 +194,19 @@ class StateStore:
         with self._lock:
             return StateSnapshot(self, self.latest_index)
 
+    def _publish(
+        self, topic: str, type_: str, key: str, payload, index: int,
+        namespace: str = "default",
+    ) -> None:
+        if self._replaying:
+            return
+        from ..stream import Event
+
+        self.events.publish([
+            Event(topic=topic, type=type_, key=key, namespace=namespace,
+                  index=index, payload=payload)
+        ])
+
     # ------------------------------------------------------------------
     # Nodes
     # ------------------------------------------------------------------
@@ -204,6 +223,7 @@ class StateStore:
             self.nodes[node.id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
+            self._publish("Node", "NodeRegistration", node.id, node, index)
 
     @journaled
     def delete_node(self, index: int, node_id: str) -> None:
@@ -211,6 +231,9 @@ class StateStore:
             if self.nodes.pop(node_id, None) is not None:
                 self.matrix.remove_node(node_id)
                 self._bump("nodes", index)
+                self._publish(
+                    "Node", "NodeDeregistered", node_id, None, index
+                )
 
     @journaled
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
@@ -227,6 +250,7 @@ class StateStore:
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
+            self._publish("Node", "NodeStatusUpdate", node_id, node, index)
 
     @journaled
     def update_node_eligibility(
@@ -244,6 +268,7 @@ class StateStore:
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
+            self._publish("Node", "NodeEligibility", node_id, node, index)
 
     @journaled
     def update_node_drain(
@@ -268,6 +293,7 @@ class StateStore:
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
+            self._publish("Node", "NodeDrain", node_id, node, index)
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
         return self.nodes.get(node_id)
@@ -311,6 +337,9 @@ class StateStore:
                     summary.summary[tg.name] = {}
                 self.job_summaries[key] = summary
             self._bump("jobs", index)
+            self._publish(
+                "Job", "JobRegistered", job.id, job, index, job.namespace
+            )
 
     @staticmethod
     def _job_spec_changed(a: Job, b: Job) -> bool:
@@ -340,6 +369,9 @@ class StateStore:
                 self.job_summaries.pop(key, None)
                 self.periodic_launch.pop(key, None)
                 self._bump("jobs", index)
+                self._publish(
+                    "Job", "JobDeregistered", job_id, None, index, namespace
+                )
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
         return self.jobs.get((namespace, job_id))
@@ -363,7 +395,9 @@ class StateStore:
     @journaled
     def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
         with self._lock:
+            upserted: List[Evaluation] = []
             for ev in evals:
+                upserted.append(ev)
                 prev = self.evals.get(ev.id)
                 ev.modify_index = index
                 if prev is None:
@@ -375,6 +409,11 @@ class StateStore:
                     ev.id
                 )
             self._bump("evals", index)
+            for ev in upserted:
+                self._publish(
+                    "Evaluation", "EvaluationUpdated", ev.id, ev, index,
+                    ev.namespace,
+                )
 
     @journaled
     def delete_eval(self, index: int, eval_id: str) -> None:
@@ -420,7 +459,9 @@ class StateStore:
     def upsert_allocs(self, index: int, allocs: Iterable[Allocation]) -> None:
         """Insert/replace allocations, keeping the device matrix in sync."""
         with self._lock:
+            upserted: List[Allocation] = []
             for alloc in allocs:
+                upserted.append(alloc)
                 prev = self.allocs.get(alloc.id)
                 alloc.modify_index = index
                 if prev is None:
@@ -461,6 +502,11 @@ class StateStore:
                         old2.modify_index = index
                         self.allocs[old2.id] = old2
             self._bump("allocs", index)
+            for alloc in upserted:
+                self._publish(
+                    "Allocation", "AllocationUpdated", alloc.id, alloc,
+                    index, alloc.namespace,
+                )
 
     @journaled
     def update_allocs_from_client(
@@ -562,6 +608,10 @@ class StateStore:
                 (deployment.namespace, deployment.job_id), set()
             ).add(deployment.id)
             self._bump("deployment", index)
+            self._publish(
+                "Deployment", "DeploymentUpserted", deployment.id,
+                deployment, index, deployment.namespace,
+            )
 
     @journaled
     def delete_deployment(self, index: int, deployment_id: str) -> None:
@@ -608,6 +658,10 @@ class StateStore:
             d2.modify_index = index
             self.deployments[deployment_id] = d2
             self._bump("deployment", index)
+            self._publish(
+                "Deployment", "DeploymentStatusUpdate", deployment_id, d2,
+                index, d2.namespace,
+            )
 
     @journaled
     def update_deployment_promotion(
@@ -634,6 +688,10 @@ class StateStore:
             d2.modify_index = index
             self.deployments[deployment_id] = d2
             self._bump("deployment", index)
+            self._publish(
+                "Deployment", "DeploymentPromotion", deployment_id, d2,
+                index, d2.namespace,
+            )
 
     def _deployment_alloc_delta(
         self, index: int, alloc: Allocation, prev: Optional[Allocation]

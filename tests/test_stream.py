@@ -1,0 +1,184 @@
+"""Event stream + latency metrics (VERDICT #8).
+
+Reference: nomad/stream/event_broker.go:30-49 (broker + subscriptions),
+/v1/event/stream NDJSON (command/agent/event_endpoint.go), and the
+nomad.worker.* / nomad.plan.* timers (worker.go:245, plan_apply.go:185)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.stream import Event, EventBroker
+
+
+def _wait(pred, timeout=30.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+# ----------------------------------------------------------------------
+# Broker unit tests
+# ----------------------------------------------------------------------
+
+
+class TestBroker:
+    def test_publish_subscribe_topic_filter(self):
+        b = EventBroker()
+        all_sub = b.subscribe()
+        job_sub = b.subscribe({"Job": ["*"]})
+        keyed = b.subscribe({"Job": ["job-1"]})
+        b.publish([
+            Event(topic="Job", type="JobRegistered", key="job-1", index=1),
+            Event(topic="Node", type="NodeRegistration", key="n1", index=2),
+        ])
+        evs = all_sub.next(timeout=2)
+        assert {e.key for e in evs} == {"job-1", "n1"}
+        evs = job_sub.next(timeout=2)
+        assert [e.key for e in evs] == ["job-1"]
+        evs = keyed.next(timeout=2)
+        assert [e.key for e in evs] == ["job-1"]
+        b.publish([
+            Event(topic="Job", type="JobRegistered", key="other", index=3)
+        ])
+        assert keyed.next(timeout=0.2) == []
+
+    def test_from_index_replays_buffer(self):
+        b = EventBroker()
+        b.publish([
+            Event(topic="Job", type="T", key=f"k{i}", index=i)
+            for i in range(1, 6)
+        ])
+        sub = b.subscribe(from_index=3)
+        evs = sub.next(timeout=2)
+        assert [e.index for e in evs] == [4, 5]
+
+    def test_close_unsubscribes(self):
+        b = EventBroker()
+        sub = b.subscribe()
+        assert b.subscriber_count() == 1
+        sub.close()
+        assert b.subscriber_count() == 0
+        assert sub.next(timeout=0.1) == []
+
+
+# ----------------------------------------------------------------------
+# Store publishes over a full lifecycle
+# ----------------------------------------------------------------------
+
+
+def test_store_publishes_lifecycle_events():
+    srv = Server(ServerConfig(num_workers=1, node_capacity=16,
+                              heartbeat_min_ttl=600, heartbeat_max_ttl=900))
+    srv.start()
+    try:
+        sub = srv.store.events.subscribe()
+        node = mock.node()
+        srv.register_node(node)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        ev = srv.submit_job(job)
+        assert srv.wait_for_eval(ev.id, timeout=60).status == "complete"
+        srv.deregister_job(job.namespace, job.id, purge=True)
+
+        seen = []
+        deadline = time.time() + 15
+        want = {
+            ("Node", "NodeRegistration"),
+            ("Job", "JobRegistered"),
+            ("Evaluation", "EvaluationUpdated"),
+            ("Allocation", "AllocationUpdated"),
+            ("Job", "JobDeregistered"),
+        }
+        while time.time() < deadline:
+            seen.extend(sub.next(timeout=0.5))
+            got = {(e.topic, e.type) for e in seen}
+            if want <= got:
+                break
+        got = {(e.topic, e.type) for e in seen}
+        assert want <= got, got
+        # Events are ordered by index.
+        idxs = [e.index for e in seen]
+        assert idxs == sorted(idxs)
+    finally:
+        srv.shutdown()
+
+
+# ----------------------------------------------------------------------
+# NDJSON over HTTP
+# ----------------------------------------------------------------------
+
+
+def test_event_stream_http_ndjson():
+    from nomad_tpu.api.agent import Agent, AgentConfig
+
+    agent = Agent(AgentConfig(
+        client_enabled=False,
+        server_config=ServerConfig(
+            num_workers=1, node_capacity=16,
+            heartbeat_min_ttl=600, heartbeat_max_ttl=900,
+        ),
+    ))
+    agent.start()
+    try:
+        url = f"{agent.rpc_addr}/v1/event/stream?topic=Job:*"
+        lines = []
+        done = threading.Event()
+
+        def consume():
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                for raw in resp:
+                    obj = json.loads(raw)
+                    if obj:
+                        lines.append(obj)
+                    if len(lines) >= 1:
+                        done.set()
+                        return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the subscription attach
+        job = mock.job()
+        agent.server.submit_job(job)
+        assert done.wait(timeout=20), "no event received over HTTP"
+        assert lines[0]["Topic"] == "Job"
+        assert lines[0]["Type"] == "JobRegistered"
+        assert lines[0]["Payload"]["id"] == job.id
+    finally:
+        agent.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Metrics timers
+# ----------------------------------------------------------------------
+
+
+def test_latency_timers_populated():
+    srv = Server(ServerConfig(num_workers=1, node_capacity=16,
+                              heartbeat_min_ttl=600, heartbeat_max_ttl=900))
+    srv.start()
+    try:
+        srv.register_node(mock.node())
+        for _ in range(3):
+            job = mock.job()
+            job.task_groups[0].count = 1
+            ev = srv.submit_job(job)
+            srv.wait_for_eval(ev.id, timeout=60)
+        snap = srv.metrics.snapshot()
+        for name in ("nomad.worker.invoke_scheduler", "nomad.plan.evaluate",
+                     "nomad.plan.apply", "nomad.eval.latency"):
+            assert name in snap, snap.keys()
+            assert snap[name]["count"] >= 1
+            assert snap[name]["p99_ms"] >= snap[name]["p50_ms"] >= 0
+    finally:
+        srv.shutdown()
