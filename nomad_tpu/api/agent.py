@@ -22,6 +22,9 @@ class AgentConfig:
     datacenter: str = "dc1"
     server_enabled: bool = True
     client_enabled: bool = True
+    # Remote server agent address for client-only agents (the wire seam:
+    # client/client.go dials servers; here HTTP at /v1/internal/*).
+    server_addr: str = ""
     http_host: str = "127.0.0.1"
     http_port: int = 0  # 0 = ephemeral
     server_config: ServerConfig = field(default_factory=ServerConfig)
@@ -37,12 +40,18 @@ class Agent:
         if self.config.server_enabled:
             self.server = Server(self.config.server_config)
         if self.config.client_enabled:
-            if self.server is None:
+            if self.server is not None:
+                server_handle = self.server
+            elif self.config.server_addr:
+                from .rpc import HTTPServerRPC
+
+                server_handle = HTTPServerRPC(self.config.server_addr)
+            else:
                 raise ValueError(
-                    "client-only agents need a remote server (not yet wired)"
+                    "client-only agents need --servers <addr> of a server agent"
                 )
             self.config.client_config.datacenter = self.config.datacenter
-            self.client = Client(self.server, self.config.client_config)
+            self.client = Client(server_handle, self.config.client_config)
 
         from .http_server import HTTPAPIServer
 

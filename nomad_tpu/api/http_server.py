@@ -161,6 +161,35 @@ class HTTPAPIServer:
             raise HTTPError(501, "agent is not running a server")
         store = server.store
 
+        # ---- internal node RPCs (client↔server wire; api/rpc.py peer) ----
+        if path.startswith("/v1/internal/"):
+            from ..structs import serde
+
+            if path == "/v1/internal/node/register":
+                node = serde.from_wire(body["Node"])
+                return {"TTL": server.register_node(node)}
+            if path == "/v1/internal/node/heartbeat":
+                return {"TTL": server.heartbeat_node(body["NodeID"])}
+            if path == "/v1/internal/node/status":
+                server.update_node_status(body["NodeID"], body["Status"])
+                return {}
+            if path == "/v1/internal/node/client-allocs":
+                wait = min(float(body.get("Wait", 30.0)), 60.0)
+                allocs, index = server.get_client_allocs(
+                    body["NodeID"],
+                    min_index=int(body.get("MinIndex", 0)),
+                    timeout=wait,
+                )
+                return {
+                    "Allocs": [serde.to_wire(a) for a in allocs],
+                    "Index": index,
+                }
+            if path == "/v1/internal/node/update-allocs":
+                updates = [serde.from_wire(w) for w in body["Allocs"]]
+                server.update_allocs_from_client(updates)
+                return {}
+            raise HTTPError(404, f"unknown internal RPC {path}")
+
         if path == "/v1/jobs" and method == "GET":
             prefix = query.get("prefix", "")
             return [

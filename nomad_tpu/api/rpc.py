@@ -1,0 +1,101 @@
+"""Client→server node RPCs over the wire.
+
+Reference: the client dials servers over yamux-multiplexed msgpack RPC
+(``client/client.go:1997`` watchAllocations → ``Node.GetClientAllocs``
+``nomad/node_endpoint.go:915``; ``registerAndHeartbeat`` :1550 →
+Node.Register/UpdateStatus; batched ``Node.UpdateAlloc`` :1054).
+
+This build's wire is HTTP+JSON (serde full-fidelity encoding, NOT the
+human-facing ``/v1`` JSON) on the server agent's existing listener, under
+``/v1/internal/``.  ``HTTPServerRPC`` implements the exact five-method
+surface the in-process ``Server`` object exposes to ``Client``, so a
+client agent runs unchanged against either — the same seam the reference
+has between ``client.RPC`` and in-process test servers.
+
+Blocking queries carry their wait budget in the request and hold the HTTP
+response open server-side (the memdb WatchSet discipline over the wire).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import List, Tuple
+
+from ..structs import serde
+from ..structs.types import Allocation, Node
+
+
+class RPCError(Exception):
+    pass
+
+
+class HTTPServerRPC:
+    """The client's handle to a remote server agent."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self.addr = addr.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------------
+
+    def _call(self, path: str, payload=None, timeout=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.addr + path,
+            data=data,
+            method="POST" if data is not None else "GET",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout
+            ) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as exc:
+            raise RPCError(
+                f"{path}: {exc.code} {exc.read().decode(errors='replace')}"
+            ) from exc
+        except urllib.error.URLError as exc:
+            raise RPCError(f"{path}: {exc.reason}") from exc
+
+    # ------------------------------------------------------------------
+    # The five-method client↔server surface
+    # ------------------------------------------------------------------
+
+    def register_node(self, node: Node) -> float:
+        out = self._call(
+            "/v1/internal/node/register", {"Node": serde.to_wire(node)}
+        )
+        return float(out["TTL"])
+
+    def heartbeat_node(self, node_id: str) -> float:
+        out = self._call(
+            "/v1/internal/node/heartbeat", {"NodeID": node_id}
+        )
+        return float(out["TTL"])
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self._call(
+            "/v1/internal/node/status",
+            {"NodeID": node_id, "Status": status},
+        )
+
+    def get_client_allocs(
+        self, node_id: str, min_index: int = 0, timeout: float = 30.0
+    ) -> Tuple[List[Allocation], int]:
+        out = self._call(
+            "/v1/internal/node/client-allocs",
+            {"NodeID": node_id, "MinIndex": min_index, "Wait": timeout},
+            # The HTTP timeout must outlast the server-side blocking wait.
+            timeout=timeout + self.timeout,
+        )
+        allocs = [serde.from_wire(w) for w in out["Allocs"]]
+        return allocs, int(out["Index"])
+
+    def update_allocs_from_client(self, updates: List[Allocation]) -> None:
+        self._call(
+            "/v1/internal/node/update-allocs",
+            {"Allocs": [serde.to_wire(a) for a in updates]},
+        )

@@ -36,9 +36,12 @@ def cmd_agent(args) -> int:
         datacenter=args.dc,
         server_enabled=not args.client_only,
         client_enabled=not args.server_only,
+        server_addr=args.servers,
         http_host=args.bind,
         http_port=args.port,
-        server_config=ServerConfig(num_workers=args.workers),
+        server_config=ServerConfig(
+            num_workers=args.workers, data_dir=args.data_dir or None
+        ),
     )
     agent = Agent(config)
     agent.start()
@@ -238,6 +241,10 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument("--workers", type=int, default=2)
     agent.add_argument("--server-only", action="store_true")
     agent.add_argument("--client-only", action="store_true")
+    agent.add_argument("--servers", default="",
+                       help="server agent address for client-only agents")
+    agent.add_argument("--data-dir", default="",
+                       help="server durability dir (WAL + snapshots)")
     agent.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job operations").add_subparsers(

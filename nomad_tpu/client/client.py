@@ -132,7 +132,7 @@ class Client:
         while not self._shutdown.is_set():
             try:
                 allocs, index = self.server.get_client_allocs(
-                    self.node.id, min_index=index, timeout=1.0
+                    self.node.id, min_index=index, timeout=10.0
                 )
             except Exception:  # noqa: BLE001
                 log.exception("alloc watch failed")
