@@ -133,6 +133,82 @@ class AllocRunner:
 
     # ------------------------------------------------------------------
 
+    def run_restored(
+        self,
+        task_states: Dict[str, TaskState],
+        handles: Dict[str, dict],
+    ) -> None:
+        """Resume a persisted alloc after agent restart: re-attach tasks
+        whose driver handles recover (RecoverTask, drivers/driver.go:54);
+        mark the rest failed so the server reschedules them."""
+        self.task_states = dict(task_states)
+        self._thread = threading.Thread(
+            target=self._run_restored,
+            args=(handles,),
+            name=f"alloc-restore-{self.alloc.id[:8]}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run_restored(self, handles: Dict[str, dict]) -> None:
+        from .driver import TaskHandle
+
+        tasks = self._tasks()
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        restart = tg.restart_policy if tg else None
+        supervised = []
+        for task in tasks:
+            if task.lifecycle_hook == "poststop":
+                continue
+            persisted = self.task_states.get(task.name)
+            if persisted is not None and persisted.state == "dead":
+                continue  # finished before the restart; keep as-is
+            raw = handles.get(task.name)
+            handle = None
+            if raw:
+                known = {
+                    k: v for k, v in raw.items()
+                    if k in TaskHandle.__dataclass_fields__
+                }
+                handle = TaskHandle(**known)
+            driver = self.drivers.get(task.driver)
+            if handle is not None and driver.recover_task(handle):
+                tr = TaskRunner(
+                    alloc_id=self.alloc.id,
+                    task=task,
+                    driver=driver,
+                    task_dir=os.path.join(self.alloc_dir, task.name),
+                    restart_policy=restart,
+                    on_state_change=self._on_task_state,
+                )
+                with self._lock:
+                    self.runners[task.name] = tr
+                tr.attach(handle)
+                supervised.append((task, tr))
+            else:
+                # Unrecoverable: the task died with the old agent.
+                st = self.task_states.get(task.name) or TaskState()
+                st.state = "dead"
+                st.failed = True
+                st.events.append({
+                    "type": "Lost",
+                    "time": time.time(),
+                    "message": "task not recoverable after agent restart",
+                })
+                self._on_task_state(task.name, st)
+        main = [
+            (t, tr) for t, tr in supervised if not t.lifecycle_hook
+        ]
+        for _, tr in main:
+            tr.wait()
+        for t, tr in supervised:
+            if t.lifecycle_sidecar:
+                tr.kill()
+        self._finalize()
+
+    # ------------------------------------------------------------------
+
     def _health_watch(self) -> None:
         """Deployment health determination (client/allochealth/tracker.go):
         healthy once all main tasks run continuously for min_healthy_time;

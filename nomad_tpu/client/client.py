@@ -61,6 +61,10 @@ class Client:
         self.data_dir = self.config.data_dir or tempfile.mkdtemp(
             prefix="nomad_tpu_client_"
         )
+        # Restart-recovery state (client/state/state_database.go analog).
+        from .state import ClientStateDB
+
+        self.state_db = ClientStateDB(self.data_dir)
 
         attrs, resources = fingerprint()
         attrs.update(self.drivers.fingerprint())
@@ -76,6 +80,12 @@ class Client:
             },
             status=NodeStatus.INIT.value,
         )
+        # A restarted agent MUST come back as the same node or its allocs
+        # would be orphaned server-side.
+        persisted_id = self.state_db.get_node_id()
+        if node is None and persisted_id:
+            self.node.id = persisted_id
+        self.state_db.put_node_id(self.node.id)
 
         self.allocs: Dict[str, AllocRunner] = {}
         self._lock = threading.Lock()
@@ -88,7 +98,10 @@ class Client:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
-        """Register and launch the heartbeat / watch / update loops."""
+        """Register and launch the heartbeat / watch / update loops.
+        Persisted allocs are restored FIRST so still-running tasks are
+        re-attached before the watch loop reconciles with the server."""
+        self._restore_allocs()
         self._ttl = self.server.register_node(self.node)
         self.node.status = NodeStatus.READY.value
         self.server.update_node_status(self.node.id, NodeStatus.READY.value)
@@ -110,6 +123,32 @@ class Client:
             self._dirty_cond.notify_all()
         for ar in list(self.allocs.values()):
             ar.destroy()
+
+    def _restore_allocs(self) -> None:
+        """Recover persisted allocs: re-attach or fail their tasks
+        (client.go restore path + alloc_runner Restore)."""
+        for alloc, states, handles in self.state_db.load_allocs():
+            if alloc.terminal_status():
+                self.state_db.delete_alloc(alloc.id)
+                continue
+            ar = AllocRunner(
+                alloc, self.drivers, self.data_dir, self._alloc_updated
+            )
+            with self._lock:
+                self.allocs[alloc.id] = ar
+            ar.run_restored(states, handles)
+
+    def _persist(self, ar: AllocRunner) -> None:
+        import dataclasses
+
+        handles = {}
+        for name, tr in list(ar.runners.items()):
+            if tr.handle is not None:
+                handles[name] = dataclasses.asdict(tr.handle)
+        try:
+            self.state_db.put_alloc_state(ar.alloc, ar.task_states, handles)
+        except OSError:
+            log.exception("persisting alloc state failed")
 
     # ------------------------------------------------------------------
 
@@ -150,6 +189,7 @@ class Client:
         for aid, ar in existing.items():
             if aid not in server_by_id:
                 ar.destroy()
+                self.state_db.delete_alloc(aid)
                 with self._lock:
                     self.allocs.pop(aid, None)
 
@@ -168,10 +208,12 @@ class Client:
                 ar.run()
             elif alloc.modify_index > ar.alloc.modify_index:
                 ar.update(alloc)
+                self._persist(ar)
 
     # ------------------------------------------------------------------
 
     def _alloc_updated(self, ar: AllocRunner) -> None:
+        self._persist(ar)
         with self._dirty_cond:
             self._dirty[ar.alloc.id] = ar
             self._dirty_cond.notify_all()

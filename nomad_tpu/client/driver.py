@@ -269,14 +269,20 @@ class RawExecDriver(Driver):
                 pass
 
     def recover_task(self, handle: TaskHandle) -> bool:
+        """Re-attach after an agent restart: the task process is no longer
+        our child (we cannot waitpid it), so supervision resumes through a
+        kill-0 polling shim — the same technique the reference's executor
+        uses for its pre-0.9 recovery shims (drivers/shared/executor)."""
         if handle.id in self._procs:
             return True
         if handle.pid:
             try:
                 os.kill(handle.pid, 0)
-                return True  # process alive but unsupervised; re-attachable
             except (ProcessLookupError, PermissionError):
                 return False
+            with self._lock:
+                self._procs[handle.id] = _ReattachedProc(handle.pid)
+            return True
         return False
 
     def inspect_task(self, handle: TaskHandle) -> str:
@@ -284,6 +290,38 @@ class RawExecDriver(Driver):
         if proc is None:
             return "unknown"
         return "running" if proc.poll() is None else "exited"
+
+
+class _ReattachedProc:
+    """Popen-shaped supervision of a non-child process (recovery path).
+
+    The exit *status* of a non-child is unobservable; disappearance is
+    reported as exit 0 with a marker in ``err`` left to the caller.
+    """
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._code: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._code is not None:
+            return self._code
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except (ProcessLookupError, PermissionError):
+            self._code = 0  # status unobservable for a non-child
+            return self._code
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            code = self.poll()
+            if code is not None:
+                return code
+            if deadline is not None and time.time() >= deadline:
+                raise subprocess.TimeoutExpired(f"pid {self.pid}", timeout)
+            time.sleep(0.05)
 
 
 class DriverRegistry:

@@ -1,0 +1,100 @@
+"""Client state persistence — restart recovery.
+
+Reference: ``client/state/state_database.go`` (BoltDB): the client persists
+its node identity, each alloc, its task states, and the **driver task
+handles** so an agent restart re-attaches to still-running tasks via
+``RecoverTask`` (``plugins/drivers/driver.go:54``) instead of killing and
+rescheduling them.
+
+Layout (JSON files under ``<data_dir>/state/``):
+
+- ``node.json`` — the node id (a restarted agent must re-register as the
+  SAME node or its allocs would be orphaned)
+- ``allocs/<alloc_id>.json`` — alloc wire + task states + task handles
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import serde
+from ..structs.types import Allocation, TaskState
+
+
+class ClientStateDB:
+    def __init__(self, data_dir: str):
+        self.dir = os.path.join(data_dir, "state")
+        self.allocs_dir = os.path.join(self.dir, "allocs")
+        os.makedirs(self.allocs_dir, exist_ok=True)
+        self.node_path = os.path.join(self.dir, "node.json")
+
+    # -- node identity --------------------------------------------------
+
+    def get_node_id(self) -> Optional[str]:
+        try:
+            with open(self.node_path, "r", encoding="utf-8") as fh:
+                return json.load(fh).get("node_id") or None
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def put_node_id(self, node_id: str) -> None:
+        tmp = self.node_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"node_id": node_id}, fh)
+        os.replace(tmp, self.node_path)
+
+    # -- allocs ---------------------------------------------------------
+
+    def _alloc_path(self, alloc_id: str) -> str:
+        return os.path.join(self.allocs_dir, f"{alloc_id}.json")
+
+    def put_alloc_state(
+        self,
+        alloc: Allocation,
+        task_states: Dict[str, TaskState],
+        handles: Dict[str, dict],
+    ) -> None:
+        """Persist one alloc's full client-side state (atomic replace —
+        a crash mid-write must not corrupt the previous record)."""
+        record = {
+            "alloc": serde.to_wire(alloc),
+            "task_states": {
+                name: serde.to_wire(st) for name, st in task_states.items()
+            },
+            "handles": handles,
+        }
+        path = self._alloc_path(alloc.id)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(record, fh)
+        os.replace(tmp, path)
+
+    def delete_alloc(self, alloc_id: str) -> None:
+        try:
+            os.unlink(self._alloc_path(alloc_id))
+        except FileNotFoundError:
+            pass
+
+    def load_allocs(
+        self,
+    ) -> List[Tuple[Allocation, Dict[str, TaskState], Dict[str, dict]]]:
+        out = []
+        for name in sorted(os.listdir(self.allocs_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.allocs_dir, name)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    record = json.load(fh)
+                alloc = serde.from_wire(record["alloc"])
+                states = {
+                    n: serde.from_wire(w)
+                    for n, w in record.get("task_states", {}).items()
+                }
+                handles = record.get("handles", {})
+            except (json.JSONDecodeError, KeyError, TypeError):
+                continue  # torn write — drop the record
+            out.append((alloc, states, handles))
+        return out

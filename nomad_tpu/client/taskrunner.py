@@ -31,6 +31,7 @@ EVENT_NOT_RESTARTING = "Not Restarting"
 EVENT_KILLING = "Killing"
 EVENT_KILLED = "Killed"
 EVENT_DRIVER_FAILURE = "Driver Failure"
+EVENT_RESTORED = "Restored"
 
 
 class TaskRunner:
@@ -56,6 +57,7 @@ class TaskRunner:
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._restarts_in_interval: List[float] = []
+        self._attached: Optional[TaskHandle] = None
 
     # ------------------------------------------------------------------
 
@@ -82,6 +84,12 @@ class TaskRunner:
         )
         self._thread.start()
 
+    def attach(self, handle: TaskHandle) -> None:
+        """Resume supervision of a recovered task (agent-restart path:
+        RecoverTask re-attached the driver; no new start)."""
+        self._attached = handle
+        self.start()
+
     def run(self) -> None:
         """MAIN loop: hooks → start → wait → restart decision."""
         self._event(EVENT_RECEIVED)
@@ -93,8 +101,10 @@ class TaskRunner:
             self._done.set()
             return
 
+        attached, self._attached = self._attached, None
         while not self._kill.is_set():
-            result = self._run_once()
+            result = self._run_once(attached=attached)
+            attached = None
             if self._kill.is_set():
                 break
             restart, delay = self._should_restart(result)
@@ -129,24 +139,33 @@ class TaskRunner:
             raise ValueError("task has no driver")
         os.makedirs(self.task_dir, exist_ok=True)
 
-    def _run_once(self) -> Optional[ExitResult]:
-        """One driver start + wait cycle. None result = start failure."""
-        handle = TaskHandle(
-            id=uuid.uuid4().hex,
-            driver=self.driver.name,
-            task_name=self.task.name,
-            alloc_id=self.alloc_id,
-        )
-        try:
-            self.driver.start_task(handle, self.task, self.task_dir)
-        except DriverError as exc:
-            # Transient until the restart policy gives up — the final dead
-            # transition sets `failed`, not each attempt.
-            self._event(EVENT_DRIVER_FAILURE, str(exc))
-            return None
-        self.handle = handle
-        self._event(EVENT_STARTED)
-        self._set_state("running")
+    def _run_once(
+        self, attached: Optional[TaskHandle] = None
+    ) -> Optional[ExitResult]:
+        """One driver start + wait cycle. None result = start failure.
+        ``attached``: a recovered handle — skip the start, just supervise."""
+        if attached is not None:
+            handle = attached
+            self.handle = handle
+            self._event(EVENT_RESTORED, "re-attached after agent restart")
+            self._set_state("running")
+        else:
+            handle = TaskHandle(
+                id=uuid.uuid4().hex,
+                driver=self.driver.name,
+                task_name=self.task.name,
+                alloc_id=self.alloc_id,
+            )
+            try:
+                self.driver.start_task(handle, self.task, self.task_dir)
+            except DriverError as exc:
+                # Transient until the restart policy gives up — the final
+                # dead transition sets `failed`, not each attempt.
+                self._event(EVENT_DRIVER_FAILURE, str(exc))
+                return None
+            self.handle = handle
+            self._event(EVENT_STARTED)
+            self._set_state("running")
 
         # Wait for exit OR kill.
         while True:
