@@ -142,6 +142,13 @@ class AllocRunner:
         whose driver handles recover (RecoverTask, drivers/driver.go:54);
         mark the rest failed so the server reschedules them."""
         self.task_states = dict(task_states)
+        # Health is set once per alloc lifetime (allochealth tracker):
+        # carry a verdict already reached before the restart so the
+        # restored watcher cannot re-run and overwrite it.
+        ds = self.alloc.deployment_status
+        if ds is not None and ds.healthy is not None:
+            self.deployment_health = ds.healthy
+            self.deployment_health_at = ds.timestamp
         self._thread = threading.Thread(
             target=self._run_restored,
             args=(handles,),
@@ -157,6 +164,18 @@ class AllocRunner:
         job = self.alloc.job
         tg = job.lookup_task_group(self.alloc.task_group) if job else None
         restart = tg.restart_policy if tg else None
+
+        # Health watching must survive the restart too: a restored
+        # deployment alloc that never reports health stalls (or falsely
+        # auto-reverts) its deployment.  Health already reported before the
+        # restart is carried in deployment_health by the restore caller.
+        if self.alloc.deployment_id and self.deployment_health is None:
+            threading.Thread(
+                target=self._health_watch,
+                name=f"health-{self.alloc.id[:8]}",
+                daemon=True,
+            ).start()
+
         supervised = []
         for task in tasks:
             if task.lifecycle_hook == "poststop":

@@ -209,8 +209,16 @@ class RawExecDriver(Driver):
         if not command:
             raise DriverError("raw_exec requires config.command")
         args = [str(command)] + [str(a) for a in cfg.get("args", [])]
-        stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab")
-        stderr = open(os.path.join(task_dir, f"{task.name}.stderr"), "ab")
+        stdout = stderr = None
+        try:
+            stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab")
+            stderr = open(os.path.join(task_dir, f"{task.name}.stderr"), "ab")
+        except OSError as exc:
+            # The alloc dir can vanish mid-restart (destroy racing the
+            # restart loop) — a start failure, not an agent crash.
+            if stdout is not None:
+                stdout.close()
+            raise DriverError(f"task dir unavailable: {exc}") from exc
         env = dict(os.environ)
         env.update({k: str(v) for k, v in (task.env or {}).items()})
         try:

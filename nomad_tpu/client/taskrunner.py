@@ -103,7 +103,16 @@ class TaskRunner:
 
         attached, self._attached = self._attached, None
         while not self._kill.is_set():
-            result = self._run_once(attached=attached)
+            try:
+                result = self._run_once(attached=attached)
+            except Exception as exc:  # noqa: BLE001 — driver bugs must not
+                # leak out of the runner thread; treat as a start failure so
+                # the restart policy (not a traceback) decides what's next.
+                log.exception(
+                    "task %s run cycle failed", self.task.name
+                )
+                self._event(EVENT_DRIVER_FAILURE, str(exc))
+                result = None
             attached = None
             if self._kill.is_set():
                 break

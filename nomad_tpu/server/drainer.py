@@ -102,27 +102,44 @@ class NodeDrainer:
         for node in draining:
             strat = node.drain_strategy
             deadline_hit = bool(strat.force_deadline) and now >= strat.force_deadline
-            remaining = []
+            migratable = []
+            system_allocs = []
             for a in store.allocs_by_node(node.id):
                 if a.terminal_status():
                     continue
                 job = a.job
                 if job is not None and job.type == JobType.SYSTEM.value:
-                    # System allocs drain only at the deadline unless the
-                    # strategy ignores them entirely (drainer.go system
-                    # handling).
-                    if strat.ignore_system_jobs or not deadline_hit:
-                        continue
-                    remaining.append(a)
+                    if not strat.ignore_system_jobs:
+                        system_allocs.append(a)
                     continue
-                remaining.append(a)
+                migratable.append(a)
 
-            if not remaining:
-                # Node is empty of drainable work → drain complete
-                # (watch_nodes.go NodesDrainComplete).
+            if not migratable:
+                # All migratable work is gone.  Stop remaining system allocs
+                # *before* marking the drain complete (watch_nodes.go:91-101
+                # drains RemainingAllocs when IsDone); only then
+                # NodesDrainComplete.
+                unstamped = [
+                    a for a in system_allocs
+                    if not a.desired_transition.should_migrate()
+                ]
+                if unstamped:
+                    for a in unstamped:
+                        transitions[a.id] = DesiredTransition(migrate=True)
+                        key = (a.namespace, a.job_id)
+                        evals_for[key] = max(
+                            evals_for.get(key, 0),
+                            a.job.priority if a.job is not None else 50,
+                        )
+                    continue
+                if system_allocs:
+                    continue  # stamped, waiting for them to stop
                 self.server.complete_node_drain(node.id)
                 continue
 
+            # At the forced deadline every remaining alloc (system included)
+            # is stamped at once, unpaced (drainer.go deadline handling).
+            remaining = migratable + (system_allocs if deadline_hit else [])
             for a in remaining:
                 if a.desired_transition.should_migrate():
                     continue  # already stamped; scheduler owns it now

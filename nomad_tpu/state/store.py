@@ -31,6 +31,7 @@ The store also forwards node/alloc deltas to the device-resident
 from __future__ import annotations
 
 import functools
+import inspect
 import threading
 import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
@@ -61,8 +62,15 @@ def journaled(fn):
     the store lock so the log order is the apply order.  Nested mutator
     calls (``upsert_plan_results`` → ``upsert_allocs``…) and replayed
     mutations are not re-journaled.
+
+    Mutators that stamp wall-clock times declare a keyword-only ``now``
+    parameter; the wrapper resolves it *before* appending so the timestamp
+    is part of the journaled args and WAL replay is deterministic (the
+    reference journals timestamps inside raft request bodies for the same
+    reason, e.g. structs.AllocUpdateRequest timestamps).
     """
     op = fn.__name__
+    has_now = "now" in inspect.signature(fn).parameters
 
     @functools.wraps(fn)
     def wrapper(self, index, *args, **kwargs):
@@ -73,6 +81,8 @@ def journaled(fn):
                 or self._journal_depth > 0
             ):
                 return fn(self, index, *args, **kwargs)
+            if has_now and kwargs.get("now") is None:
+                kwargs["now"] = _time.time()
             from ..structs import serde
 
             self.wal.append(
@@ -236,7 +246,9 @@ class StateStore:
                 )
 
     @journaled
-    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+    def update_node_status(
+        self, index: int, node_id: str, status: str, *, now: float = None
+    ) -> None:
         with self._lock:
             prev = self.nodes.get(node_id)
             if prev is None:
@@ -246,7 +258,7 @@ class StateStore:
             node = _copy.copy(prev)
             node.status = status
             node.modify_index = index
-            node.status_updated_at = _time.time()
+            node.status_updated_at = now if now is not None else _time.time()
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
@@ -456,9 +468,13 @@ class StateStore:
             s.discard(alloc.id)
 
     @journaled
-    def upsert_allocs(self, index: int, allocs: Iterable[Allocation]) -> None:
+    def upsert_allocs(
+        self, index: int, allocs: Iterable[Allocation], *, now: float = None
+    ) -> None:
         """Insert/replace allocations, keeping the device matrix in sync."""
         with self._lock:
+            if now is None:
+                now = _time.time()
             upserted: List[Allocation] = []
             for alloc in allocs:
                 upserted.append(alloc)
@@ -487,7 +503,7 @@ class StateStore:
                 self.allocs[alloc.id] = alloc
                 self._index_alloc(alloc)
                 self._update_summary(alloc, prev, index)
-                self._deployment_alloc_delta(index, alloc, prev)
+                self._deployment_alloc_delta(index, alloc, prev, now)
 
                 # Stamp the replaced alloc so it is never rescheduled twice
                 # (reference: UpsertAllocs sets NextAllocation on the
@@ -510,7 +526,7 @@ class StateStore:
 
     @journaled
     def update_allocs_from_client(
-        self, index: int, updates: Iterable[Allocation]
+        self, index: int, updates: Iterable[Allocation], *, now: float = None
     ) -> None:
         """Client status updates (Node.UpdateAlloc path,
         nomad/node_endpoint.go:1054): merge client fields into stored alloc."""
@@ -529,7 +545,7 @@ class StateStore:
                 alloc.deployment_status = upd.deployment_status
                 merged.append(alloc)
             if merged:
-                self.upsert_allocs(index, merged)
+                self.upsert_allocs(index, merged, now=now)
 
     @journaled
     def delete_alloc(self, index: int, alloc_id: str) -> None:
@@ -694,7 +710,8 @@ class StateStore:
             )
 
     def _deployment_alloc_delta(
-        self, index: int, alloc: Allocation, prev: Optional[Allocation]
+        self, index: int, alloc: Allocation, prev: Optional[Allocation],
+        now: float,
     ) -> None:
         """Maintain per-TG deployment counters as allocs are placed and
         report health (updateDeploymentWithAlloc, state_store.go).  Called
@@ -741,7 +758,7 @@ class StateStore:
             # Health progress extends the progress deadline
             # (deployment_watcher.go progress tracking).
             st2.require_progress_by = (
-                _time.time() + st2.progress_deadline
+                now + st2.progress_deadline
                 if st2.progress_deadline
                 else st2.require_progress_by
             )
@@ -804,6 +821,8 @@ class StateStore:
         deployment: Optional[Deployment] = None,
         deployment_updates: Optional[List] = None,
         evals: Optional[List[Evaluation]] = None,
+        *,
+        now: float = None,
     ) -> None:
         with self._lock:
             if deployment is not None:
@@ -817,7 +836,7 @@ class StateStore:
                     d2.status = upd.status
                     d2.status_description = upd.status_description
                     self.upsert_deployment(index, d2)
-            self.upsert_allocs(index, stops + preemptions + allocs)
+            self.upsert_allocs(index, stops + preemptions + allocs, now=now)
             if evals:
                 self.upsert_evals(index, evals)
 
@@ -883,6 +902,9 @@ class StateStore:
                     getattr(self, e["op"])(e["i"], *args, **kwargs)
             finally:
                 self._replaying = False
+            # Restore re-publishes nothing: everything up to the restored
+            # index is unservable backlog for event subscribers.
+            self.events.mark_history_truncated(self.latest_index)
 
     def _restore_snapshot(self, snap: dict, serde) -> None:
         # Replay through the mutators so derived state (matrix rows, alloc

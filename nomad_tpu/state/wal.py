@@ -10,14 +10,18 @@ the leader rebuilds in-memory services (broker, periodic) from state
 This build is a single-voter deployment of the same discipline:
 
 - Every **top-level** store mutation is appended to ``wal.jsonl`` as
-  ``{"i": index, "op": method, "a": wire-args}`` *before* it is applied
-  (write-ahead).  Nested mutations (e.g. ``upsert_plan_results`` calling
-  ``upsert_allocs``) are not journaled — replaying the outer op re-executes
-  them deterministically.
+  ``{"i": index, "s": seq, "op": method, "a": wire-args}`` *before* it is
+  applied (write-ahead).  ``s`` is a per-entry monotonic sequence number —
+  raft indices are per-*batch* (several entries may share one index), so
+  replay cut-points key on the sequence, never the index.  Nested mutations
+  (e.g. ``upsert_plan_results`` calling ``upsert_allocs``) are not
+  journaled — replaying the outer op re-executes them deterministically.
 - ``write_snapshot`` atomically persists the full store image
-  (tmp + rename), then rotates the log.  Entries with ``index <=`` the
-  snapshot index are skipped at load, so a crash between snapshot and
-  rotation cannot double-apply.
+  (tmp + rename) stamped with the last applied sequence (``wal_seq``),
+  then rotates the log.  Entries with ``seq <=`` the snapshot's are
+  skipped at load, so a crash between snapshot and rotation cannot
+  double-apply — and same-index entries appended *after* a mid-batch
+  snapshot are still replayed (they have a later sequence).
 - The device ``NodeMatrix`` is NOT persisted: restore replays mutations
   through the store, whose mutators feed the matrix incrementally — the
   HBM image is rebuilt as a side effect (SURVEY.md §7 hard-part a).
@@ -53,6 +57,9 @@ class WriteAheadLog:
         self.snapshot_path = os.path.join(data_dir, SNAPSHOT_NAME)
         self._fh = None
         self.appends_since_snapshot = 0
+        # Per-entry sequence: strictly monotonic across the WAL's lifetime,
+        # resumed from the on-disk tail by load().
+        self.seq = 0
 
     # ------------------------------------------------------------------
     # Load (restore path)
@@ -66,10 +73,14 @@ class WriteAheadLog:
         """
         snapshot = None
         snap_index = -1
+        snap_seq = None
         if os.path.exists(self.snapshot_path):
             with open(self.snapshot_path, "r", encoding="utf-8") as fh:
                 snapshot = json.load(fh)
             snap_index = snapshot.get("latest_index", -1)
+            snap_seq = snapshot.get("wal_seq")
+            if snap_seq is not None:
+                self.seq = max(self.seq, snap_seq)
 
         entries: List[dict] = []
         if os.path.exists(self.log_path):
@@ -85,8 +96,15 @@ class WriteAheadLog:
                     if pos == len(lines) - 1:
                         break  # torn final append from a crash — drop it
                     raise
-                if entry["i"] <= snap_index:
-                    continue  # already folded into the snapshot
+                seq = entry.get("s")
+                if seq is not None:
+                    self.seq = max(self.seq, seq)
+                if seq is not None and snap_seq is not None:
+                    if seq <= snap_seq:
+                        continue  # already folded into the snapshot
+                elif entry["i"] <= snap_index:
+                    # Legacy entry (or pre-seq snapshot): index cut-point.
+                    continue
                 entries.append(entry)
         return snapshot, entries
 
@@ -101,7 +119,11 @@ class WriteAheadLog:
 
     def append(self, index: int, op: str, args_wire: Any) -> None:
         fh = self._open()
-        fh.write(json.dumps({"i": index, "op": op, "a": args_wire}) + "\n")
+        self.seq += 1
+        fh.write(
+            json.dumps({"i": index, "s": self.seq, "op": op, "a": args_wire})
+            + "\n"
+        )
         fh.flush()
         if self.fsync:
             os.fsync(fh.fileno())
@@ -112,6 +134,8 @@ class WriteAheadLog:
     # ------------------------------------------------------------------
 
     def write_snapshot(self, snapshot_wire: dict) -> None:
+        # Stamp the cut-point: entries with seq <= wal_seq are folded in.
+        snapshot_wire["wal_seq"] = self.seq
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(snapshot_wire, fh)

@@ -103,12 +103,31 @@ class EventBroker:
         self._buffer: deque = deque(maxlen=buffer_size)
         self._subs: List[Subscription] = []
         self.latest_index = 0
+        # Highest index known to be unservable from the backlog: events
+        # evicted from the ring, plus (after a restart) all pre-restore
+        # history — restore does not re-publish, so a reconnecting
+        # subscriber with a pre-restart cursor must see a gap marker.
+        # A ``from_index`` at or below this cannot be served gaplessly.
+        self._dropped_through = 0
+
+    def mark_history_truncated(self, through_index: int) -> None:
+        """Declare that no event with index <= ``through_index`` can be
+        replayed (called by the store after a WAL/snapshot restore)."""
+        with self._lock:
+            if through_index > self._dropped_through:
+                self._dropped_through = through_index
 
     def publish(self, events: List[Event]) -> None:
         if not events:
             return
         with self._lock:
-            self._buffer.extend(events)
+            maxlen = self._buffer.maxlen
+            for e in events:
+                if maxlen is not None and len(self._buffer) == maxlen:
+                    evicted = self._buffer[0]
+                    if evicted.index > self._dropped_through:
+                        self._dropped_through = evicted.index
+                self._buffer.append(e)
             if events[-1].index > self.latest_index:
                 self.latest_index = events[-1].index
             subs = list(self._subs)
@@ -121,10 +140,31 @@ class EventBroker:
         from_index: int = 0,
     ) -> Subscription:
         """Subscribe to topics ({topic: [keys]}, default everything).
-        ``from_index`` > 0 replays buffered events newer than it first."""
+        ``from_index`` > 0 replays buffered events newer than it first.
+
+        When events newer than ``from_index`` have already been evicted
+        from the ring, the replay is *gapped*: the subscription's first
+        event is a synthetic ``Framework/EventStreamGap`` control event
+        (bypassing topic filters) telling the consumer the earliest index
+        the backlog actually covers, so it can resync with a list call
+        instead of silently consuming a history with a hole in it.
+        """
         sub = Subscription(self, topics or {TOPIC_ALL: [TOPIC_ALL]})
         with self._lock:
             if from_index:
+                if self._dropped_through > from_index:
+                    gap = Event(
+                        topic="Framework",
+                        type="EventStreamGap",
+                        key="",
+                        index=self._dropped_through,
+                        payload={
+                            "requested_index": from_index,
+                            "dropped_through": self._dropped_through,
+                        },
+                    )
+                    with sub._cond:
+                        sub._queue.append(gap)
                 sub._offer(
                     [e for e in self._buffer if e.index > from_index]
                 )

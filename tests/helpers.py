@@ -1,0 +1,50 @@
+"""Shared test helpers (the tier-2 in-process agent pattern, SURVEY.md §4).
+
+Kept in one module so wait/crash semantics can't drift between suites.
+"""
+
+from __future__ import annotations
+
+import time
+
+from nomad_tpu.client import Client, ClientConfig
+
+
+def _wait(pred, timeout=30.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _small(job):
+    """Shrink a mock job's asks so many fit on one mock node."""
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.cpu = 20
+            t.resources.memory_mb = 32
+        tg.ephemeral_disk.size_mb = 10
+    return job
+
+
+def _client(server, tmp_path, name, **cfg) -> Client:
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / name), **cfg))
+    c.start()
+    return c
+
+
+def _crash_client(client):
+    """Simulate an agent crash: stop loops WITHOUT destroying allocs or
+    killing tasks (Client.shutdown would tear the tasks down)."""
+    client._shutdown.set()
+    with client._dirty_cond:
+        client._dirty_cond.notify_all()
+
+
+def _live(server, job):
+    return [
+        a for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
