@@ -62,8 +62,38 @@ def make_mesh(
 
 
 def stack_requests(reqs: Sequence[SchedRequest]) -> SchedRequest:
-    """Stack B per-eval requests into one batched pytree (leading B axis)."""
-    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *reqs)
+    """Stack B per-eval requests into one batched pytree (leading B axis).
+
+    Trailing padding in the per-predicate dimensions (constraints,
+    affinities, static ports, datacenters) is narrowed to the batch's
+    actual maximum, pow2-bucketed so the jit cache stays bounded.  The
+    per-predicate column gathers are the dominant HBM traffic of a batched
+    dispatch (see kernels._check_predicate); typical jobs use 2-4 of the
+    16 constraint slots, so this cuts the gather volume ~4x.
+    """
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *reqs)
+
+    def width(active: np.ndarray, cap: int) -> int:
+        count = int(active.sum(axis=1).max()) if len(active) else 0
+        return min(cap, pow2_bucket(max(1, count)))
+
+    cw = width(stacked.c_slot >= 0, stacked.c_slot.shape[1])
+    aw = width(stacked.a_slot >= 0, stacked.a_slot.shape[1])
+    pw = width(stacked.p_static >= 0, stacked.p_static.shape[1])
+    dw = width(stacked.dc_hash != 0, stacked.dc_hash.shape[1])
+    return stacked._replace(
+        c_slot=stacked.c_slot[:, :cw],
+        c_op=stacked.c_op[:, :cw],
+        c_hash=stacked.c_hash[:, :cw],
+        c_num=stacked.c_num[:, :cw],
+        a_slot=stacked.a_slot[:, :aw],
+        a_op=stacked.a_op[:, :aw],
+        a_hash=stacked.a_hash[:, :aw],
+        a_num=stacked.a_num[:, :aw],
+        a_weight=stacked.a_weight[:, :aw],
+        p_static=stacked.p_static[:, :pw],
+        dc_hash=stacked.dc_hash[:, :dw],
+    )
 
 
 def build_batch_inputs(matrix, requests: Sequence[SchedRequest]) -> dict:
