@@ -47,12 +47,14 @@ DISPATCHES = int(os.environ.get("BENCH_DISPATCHES", "100"))
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE", "8"))
 JOB_SHAPES = 8
 
-# End-to-end loop knobs.
+# End-to-end loop knobs.  Worker count is the in-flight eval bound: with
+# the dispatch coalescer batching every in-flight select into one kernel
+# call, throughput scales with workers until the host (GIL) saturates.
 E2E = os.environ.get("BENCH_E2E", "1") != "0"
-E2E_JOBS = int(os.environ.get("BENCH_E2E_JOBS", "256"))
+E2E_JOBS = int(os.environ.get("BENCH_E2E_JOBS", "512"))
 E2E_GROUP_COUNT = int(os.environ.get("BENCH_E2E_COUNT", "2"))
 E2E_PROBES = int(os.environ.get("BENCH_E2E_PROBES", "50"))
-E2E_WORKERS = int(os.environ.get("BENCH_E2E_WORKERS", "4"))
+E2E_WORKERS = int(os.environ.get("BENCH_E2E_WORKERS", "32"))
 
 
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
@@ -321,11 +323,13 @@ def _run_e2e(srv, result: dict) -> None:
         node.attributes = dict(node.attributes)
         node.attributes["rack"] = f"r{i % 32}"
         srv.register_node(node)
-    # Pre-load usage so binpack sees a non-trivial cluster.
-    host = srv.matrix.snapshot_host()
-    usage = rng.uniform(0.1, 0.6, (N_NODES, 3)) * host["totals"][:N_NODES]
-    host["used"][:N_NODES] = usage
-    srv.matrix._dirty.update(range(N_NODES))
+    # Pre-load usage so binpack sees a non-trivial cluster (under the host
+    # lock — the coalescer's sync drain runs concurrently).
+    with srv.matrix._host_lock:
+        host = srv.matrix.snapshot_host()
+        usage = rng.uniform(0.1, 0.6, (N_NODES, 3)) * host["totals"][:N_NODES]
+        host["used"][:N_NODES] = usage
+        srv.matrix._dirty.update(range(N_NODES))
 
     def make_job(i: int):
         job = mock.job()
@@ -335,9 +339,10 @@ def _run_e2e(srv, result: dict) -> None:
         tg.tasks[0].resources.memory_mb = 64 + 32 * (i % 3)
         return job
 
-    # Warm the select path (first kernel compile) outside the timed region.
+    # Warm the select path (first place_batch compile — can take minutes on
+    # a cold TPU cache) outside the timed region.
     ev = srv.submit_job(make_job(0))
-    srv.wait_for_eval(ev.id, timeout=120.0)
+    srv.wait_for_eval(ev.id, timeout=600.0)
 
     # Throughput: a burst of jobs, wall-clock until every eval terminal.
     evals = []
@@ -395,6 +400,8 @@ def _run_e2e(srv, result: dict) -> None:
         e2e_jobs=E2E_JOBS,
         e2e_placements_per_eval=E2E_GROUP_COUNT,
         e2e_workers=E2E_WORKERS,
+        e2e_coalescer_dispatches=srv.coalescer.dispatches,
+        e2e_coalesced_selects=srv.coalescer.coalesced_requests,
     )
     if timeouts:
         result["e2e_probe_timeouts"] = timeouts

@@ -102,7 +102,15 @@ class Client:
         Persisted allocs are restored FIRST so still-running tasks are
         re-attached before the watch loop reconciles with the server."""
         self._restore_allocs()
-        self._ttl = self.server.register_node(self.node)
+        # Register a COPY: the store owns objects handed to it (immutability
+        # discipline, state/store.py) — in-process, passing self.node by
+        # reference let the status mutation below leak into the store before
+        # update_node_status read it, so became_ready never fired and
+        # blocked evals missed the new node's capacity.  The HTTP wire
+        # copies via serde; the in-process seam must match.
+        import copy as _copy
+
+        self._ttl = self.server.register_node(_copy.deepcopy(self.node))
         self.node.status = NodeStatus.READY.value
         self.server.update_node_status(self.node.id, NodeStatus.READY.value)
         for target, name in (

@@ -179,10 +179,14 @@ def feasibility_mask(arrays, req: SchedRequest, class_elig=None, host_mask=None)
 def system_feasible(arrays, used0, req: SchedRequest, class_elig, host_mask):
     """Fused system-scheduler pass: feasibility ∧ fit for every node in one
     compiled program (SystemStack, stack.go:183-321 — system jobs need no
-    ranking, just the all-node mask)."""
+    ranking, just the all-node mask).
+
+    Returns ONE stacked (2, N) bool array [mask, fits] so the host pays a
+    single device→host fetch (each separate fetch costs a full tunnel
+    round-trip — see bench.py rtt_floor_ms)."""
     mask = feasibility_mask(arrays, req, class_elig, host_mask)
     fits, _, _ = fit_and_binpack(arrays, used0, req)
-    return mask, fits
+    return jnp.stack([mask, fits])
 
 
 # ---------------------------------------------------------------------------
@@ -543,8 +547,7 @@ def _update_spread_counts(spread_counts, req: SchedRequest, arrays, row):
     return new_hashes, new_counts
 
 
-@functools.partial(jax.jit, static_argnames=("n_placements",))
-def place_task_group(
+def _place_scan(
     arrays,
     req: SchedRequest,
     used0,
@@ -555,19 +558,8 @@ def place_task_group(
     host_mask,
     n_placements: int,
 ) -> PlacementResult:
-    """Place ``n_placements`` allocs of one TG — the kernel behind
-    computePlacements (generic_sched.go:472).
-
-    A lax.scan over placements: each step scores all nodes, takes the argmax
-    (replacing Limit/MaxScore sampling, stack.go:78-91), and scatters the
-    proposed usage so subsequent placements see it (ProposedAllocs semantics,
-    rank.go:41-52).
-
-    ``used0`` (N, 3) is the proposed base usage — the authoritative matrix
-    usage already adjusted by the reconciler's planned stops/evictions
-    (the reference's ProposedAllocs = existing − plan.NodeUpdate + in-plan,
-    scheduler/context.go ProposedAllocs).
-    """
+    """Traceable core of the placement scan (shared by the solo
+    ``place_task_group`` jit and the coalesced ``place_batch`` vmap)."""
 
     def step(carry, _):
         used, tg_cnt, s_hash, s_counts = carry
@@ -617,6 +609,107 @@ def place_task_group(
         nodes_exhausted=n_exh,
         used_after=used_after,
         tg_count_after=tg_after,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_placements",))
+def place_task_group(
+    arrays,
+    req: SchedRequest,
+    used0,
+    tg_count,
+    spread_counts,
+    penalty_mask,
+    class_elig,
+    host_mask,
+    n_placements: int,
+) -> PlacementResult:
+    """Place ``n_placements`` allocs of one TG — the kernel behind
+    computePlacements (generic_sched.go:472).
+
+    A lax.scan over placements: each step scores all nodes, takes the argmax
+    (replacing Limit/MaxScore sampling, stack.go:78-91), and scatters the
+    proposed usage so subsequent placements see it (ProposedAllocs semantics,
+    rank.go:41-52).
+
+    ``used0`` (N, 3) is the proposed base usage — the authoritative matrix
+    usage already adjusted by the reconciler's planned stops/evictions
+    (the reference's ProposedAllocs = existing − plan.NodeUpdate + in-plan,
+    scheduler/context.go ProposedAllocs).
+    """
+    return _place_scan(
+        arrays, req, used0, tg_count, spread_counts, penalty_mask,
+        class_elig, host_mask, n_placements,
+    )
+
+
+# Columns of place_batch's packed per-request output (one fetch per
+# dispatch; each separate device→host fetch costs a tunnel round-trip).
+PACKED_ROW = 0
+PACKED_SCORE = 1
+PACKED_BINPACK = 2
+PACKED_PREEMPT = 3
+PACKED_EVALUATED = 4
+PACKED_FILTERED = 5
+PACKED_EXHAUSTED = 6
+PACKED_WIDTH = 7
+
+
+@functools.partial(jax.jit, static_argnames=("n_placements",))
+def place_batch(
+    arrays,
+    used,
+    delta_rows,
+    delta_vals,
+    tg_counts,
+    spread_counts,
+    penalties,
+    reqs,
+    class_eligs,
+    host_masks,
+    n_placements: int,
+) -> jnp.ndarray:
+    """B independent placement scans in ONE dispatch — the device side of
+    the dispatch coalescer (scheduler/coalescer.py).
+
+    Where the reference scales scheduling by optimistic worker concurrency
+    (worker.go:49-53) with each worker walking nodes alone, here concurrent
+    workers' selects coalesce into one vmapped scan over the shared matrix;
+    conflicting picks stay the plan applier's job (plan_apply.go:49-69).
+
+    Per-request args lead with a B axis. ``delta_rows``/``delta_vals``
+    ((B, K) i32 / (B, K, 3) f32, row -1 = padding) carry each request's
+    sparse in-flight plan usage deltas — applied to the shared ``used``
+    inside the kernel so the host never materializes a dense per-request
+    usage matrix.
+
+    Returns a packed (B, n_placements, PACKED_WIDTH) f32 array (row ids and
+    counts are exact in f32 up to 2^24) so the host pays ONE fetch.
+    """
+
+    def one(drows, dvals, tg, sc, pen, req, ce, hm):
+        safe = jnp.maximum(drows, 0)
+        add = jnp.where((drows >= 0)[:, None], dvals, 0.0)
+        used0 = used.at[safe].add(add)
+        res = _place_scan(
+            arrays, req, used0, tg, sc, pen, ce, hm, n_placements
+        )
+        return jnp.stack(
+            [
+                res.rows.astype(jnp.float32),
+                res.scores,
+                res.binpack,
+                res.preempted.astype(jnp.float32),
+                res.nodes_evaluated.astype(jnp.float32),
+                res.nodes_filtered.astype(jnp.float32),
+                res.nodes_exhausted.astype(jnp.float32),
+            ],
+            axis=1,
+        )  # (P, 7)
+
+    return jax.vmap(one)(
+        delta_rows, delta_vals, tg_counts, spread_counts, penalties, reqs,
+        class_eligs, host_masks,
     )
 
 

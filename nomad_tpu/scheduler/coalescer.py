@@ -1,0 +1,356 @@
+"""Dispatch coalescer — one device thread batching concurrent selects.
+
+Round-3 diagnosis: every worker's ``select()`` held the global DEVICE_LOCK
+across its own kernel dispatch, and fetched seven result buffers
+individually — through the TPU tunnel each fetch costs a full sync
+round-trip (bench.py ``rtt_floor_ms``, ~65ms observed), so four workers
+serialized into ~1.5 evals/sec end-to-end while the batched kernel sat
+unused outside the bench.
+
+This module makes the batched kernel THE live path: workers enqueue
+compiled placement requests and block on a future; a single device thread
+drains the queue, stacks up to ``max_lanes`` requests, and issues ONE
+``ops.kernels.place_batch`` dispatch whose packed result costs ONE fetch.
+Up to ``max_inflight`` dispatches are kept in flight so the tunnel
+round-trip amortizes across batches (the same pipelining bench.py
+measures).
+
+Shape discipline (SURVEY.md §7 hard-part e — p99 means no recompiles):
+every dispatch uses the SAME static shapes — ``max_lanes`` lanes (short
+batches padded with inert requests) and a ``PLACEMENT_CHUNK``-long scan
+(callers take the first rows they asked for) — so exactly one executable
+serves every batch size. Wasted lanes cost ~µs of MXU time; a recompile
+costs tens of seconds.
+
+The reference's analog: many schedulers walk nodes concurrently and the
+plan applier serializes commits (worker.go:49-53, plan_apply.go:49-69).
+The optimistic-concurrency contract is unchanged — coalesced selects may
+pick conflicting nodes; the applier's re-verify catches it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops import kernels
+from ..ops.encode import SchedRequest
+from ..state.matrix import DEVICE_LOCK
+
+log = logging.getLogger(__name__)
+
+# Sparse plan-delta capacity per request; selects with more touched rows
+# fall back to the solo dispatch path.
+MAX_DELTA_ROWS = 32
+
+
+@dataclass
+class PlaceOutcome:
+    """Unpacked per-request result (numpy, host-side)."""
+
+    rows: np.ndarray  # (P,) i32
+    scores: np.ndarray  # (P,) f32
+    binpack: np.ndarray  # (P,) f32
+    preempted: np.ndarray  # (P,) bool
+    nodes_evaluated: np.ndarray  # (P,) i32
+    nodes_filtered: np.ndarray  # (P,) i32
+    nodes_exhausted: np.ndarray  # (P,) i32
+
+
+@dataclass
+class _DeviceOp:
+    fn: "callable"
+    done: threading.Event = field(default_factory=threading.Event)
+    result: object = None
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class _Pending:
+    request: SchedRequest
+    delta_rows: np.ndarray  # (MAX_DELTA_ROWS,) i32, -1 padded
+    delta_vals: np.ndarray  # (MAX_DELTA_ROWS, 3) f32
+    tg_count: np.ndarray  # (N,) i32
+    spread_counts: np.ndarray  # (S, V) f32
+    penalty: np.ndarray  # (N,) bool
+    class_elig: np.ndarray  # (pad,) bool
+    host_mask: np.ndarray  # (N,) bool
+    done: threading.Event = field(default_factory=threading.Event)
+    outcome: Optional[PlaceOutcome] = None
+    error: Optional[BaseException] = None
+
+
+class DeviceCoalescer:
+    """The single dispatch port for the shared device matrix."""
+
+    def __init__(
+        self,
+        matrix,
+        max_lanes: int = 64,
+        scan_length: Optional[int] = None,
+        linger_s: float = 0.002,
+        max_inflight: int = 4,
+    ):
+        from .stack import PLACEMENT_CHUNK
+
+        self.matrix = matrix
+        self.max_lanes = max_lanes
+        self.scan_length = scan_length or PLACEMENT_CHUNK
+        self.linger_s = linger_s
+        self.max_inflight = max_inflight
+        self._queue: List[_Pending] = []
+        # Arbitrary device closures (system feasibility, bulk plan verify,
+        # oversized-delta solo selects) executed on the dispatch thread so
+        # the live server has exactly ONE device-touching thread — the
+        # single-chip tunnel client wedges under concurrent host threads
+        # (state/matrix.py DEVICE_LOCK note).
+        self._ops: List["_DeviceOp"] = []
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.dispatches = 0
+        self.coalesced_requests = 0
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # leadership can cycle; one dispatch thread only
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="device-coalescer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # ------------------------------------------------------------------
+
+    def place(
+        self,
+        request: SchedRequest,
+        delta_rows: np.ndarray,
+        delta_vals: np.ndarray,
+        tg_count: np.ndarray,
+        spread_counts: np.ndarray,
+        penalty: np.ndarray,
+        class_elig: np.ndarray,
+        host_mask: np.ndarray,
+        timeout: float = 600.0,  # must cover a cold TPU jit compile
+    ) -> PlaceOutcome:
+        """Submit one placement request; blocks until its batch lands.
+        The scan always runs ``scan_length`` steps — take ``rows[:k]``."""
+        p = _Pending(
+            request=request,
+            delta_rows=delta_rows,
+            delta_vals=delta_vals,
+            tg_count=tg_count,
+            spread_counts=spread_counts,
+            penalty=penalty,
+            class_elig=class_elig,
+            host_mask=host_mask,
+        )
+        with self._cond:
+            if self._stop.is_set():
+                raise RuntimeError("coalescer stopped")
+            self._queue.append(p)
+            self._cond.notify()
+        if not p.done.wait(timeout=timeout):
+            raise TimeoutError("coalescer dispatch timed out")
+        if p.error is not None:
+            raise p.error
+        assert p.outcome is not None
+        return p.outcome
+
+    def run_device_op(self, fn, timeout: float = 600.0):
+        """Execute ``fn()`` on the dispatch thread and return its result.
+
+        The escape hatch for device work that doesn't fit the batched
+        placement shape (system feasibility sweeps, bulk plan verification,
+        oversized-delta selects): they still run on the one device thread
+        instead of racing it on the tunnel."""
+        op = _DeviceOp(fn=fn)
+        with self._cond:
+            if self._stop.is_set():
+                raise RuntimeError("coalescer stopped")
+            self._ops.append(op)
+            self._cond.notify()
+        if not op.done.wait(timeout=timeout):
+            raise TimeoutError("device op timed out")
+        if op.error is not None:
+            raise op.error
+        return op.result
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        inflight: List[Tuple[object, List[_Pending]]] = []
+        while True:
+            self._drain_ops()
+            batch = self._next_batch(block=not inflight)
+            if batch is None and self._stop.is_set() and not inflight:
+                with self._cond:
+                    leftover_ops, self._ops = self._ops, []
+                    leftover_q, self._queue = self._queue, []
+                err = RuntimeError("coalescer stopped")
+                for op in leftover_ops:
+                    op.error = err
+                    op.done.set()
+                for p in leftover_q:
+                    p.error = err
+                    p.done.set()
+                return
+            if batch:
+                try:
+                    out = self._dispatch(batch)
+                    inflight.append((out, batch))
+                    self.dispatches += 1
+                    self.coalesced_requests += len(batch)
+                except BaseException as exc:  # noqa: BLE001
+                    for p in batch:
+                        p.error = exc
+                        p.done.set()
+            # Fetch the oldest dispatch when the pipe is full or there is
+            # nothing new to issue — keeps up to max_inflight overlapping
+            # the tunnel round-trip.
+            if inflight and (len(inflight) >= self.max_inflight or not batch):
+                out, entries = inflight.pop(0)
+                self._resolve(out, entries)
+
+    def _drain_ops(self) -> None:
+        while True:
+            with self._cond:
+                if not self._ops:
+                    return
+                op = self._ops.pop(0)
+            try:
+                op.result = op.fn()
+            except BaseException as exc:  # noqa: BLE001
+                op.error = exc
+            op.done.set()
+
+    def _next_batch(self, block: bool) -> Optional[List[_Pending]]:
+        with self._cond:
+            if not self._queue and block:
+                self._cond.wait_for(
+                    lambda: self._queue or self._ops or self._stop.is_set(),
+                    timeout=0.2,
+                )
+            if not self._queue:
+                return None
+        # Linger briefly so concurrent workers land in one dispatch.
+        if self.linger_s:
+            self._stop.wait(self.linger_s)
+        with self._cond:
+            batch = self._queue[: self.max_lanes]
+            del self._queue[: len(batch)]
+        return batch or None
+
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, batch: List[_Pending]):
+        import jax
+
+        with DEVICE_LOCK:
+            arrays = self.matrix.sync()
+        n = int(arrays.used.shape[0])
+
+        # Requests built just before a matrix growth or a class-count pow2
+        # crossing carry narrower arrays; pad each by its OWN width
+        # (new rows masked off — they were not host-checked; unknown
+        # classes eligible, matching _class_eligibility's default).
+        for p in batch:
+            if p.host_mask.shape[0] < n:
+                p.host_mask = np.concatenate([
+                    p.host_mask,
+                    np.zeros((n - p.host_mask.shape[0],), bool),
+                ])
+            if p.tg_count.shape[0] < n:
+                p.tg_count = np.concatenate([
+                    p.tg_count,
+                    np.zeros((n - p.tg_count.shape[0],), np.int32),
+                ])
+            if p.penalty.shape[0] < n:
+                p.penalty = np.concatenate([
+                    p.penalty,
+                    np.zeros((n - p.penalty.shape[0],), bool),
+                ])
+        cw = max(p.class_elig.shape[0] for p in batch)
+        for p in batch:
+            if p.class_elig.shape[0] < cw:
+                p.class_elig = np.concatenate([
+                    p.class_elig,
+                    np.ones((cw - p.class_elig.shape[0],), bool),
+                ])
+
+        # Pad to the fixed lane count with inert copies of the first
+        # request (host_mask all-False → every placement fails cheaply).
+        lanes: List[_Pending] = list(batch)
+        if len(lanes) < self.max_lanes:
+            inert = batch[0]
+            dead_mask = np.zeros_like(inert.host_mask)
+            filler = _Pending(
+                request=inert.request,
+                delta_rows=np.full_like(inert.delta_rows, -1),
+                delta_vals=np.zeros_like(inert.delta_vals),
+                tg_count=inert.tg_count,
+                spread_counts=inert.spread_counts,
+                penalty=inert.penalty,
+                class_elig=inert.class_elig,
+                host_mask=dead_mask,
+            )
+            lanes.extend([filler] * (self.max_lanes - len(lanes)))
+
+        reqs = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[p.request for p in lanes]
+        )
+        packed = kernels.place_batch(
+            arrays,
+            arrays.used,
+            np.stack([p.delta_rows for p in lanes]),
+            np.stack([p.delta_vals for p in lanes]),
+            np.stack([p.tg_count for p in lanes]),
+            np.stack([p.spread_counts for p in lanes]),
+            np.stack([p.penalty for p in lanes]),
+            reqs,
+            np.stack([p.class_elig for p in lanes]),
+            np.stack([p.host_mask for p in lanes]),
+            n_placements=self.scan_length,
+        )
+        return packed
+
+    def _resolve(self, packed, entries: List[_Pending]) -> None:
+        try:
+            arr = np.asarray(packed)  # ONE device→host fetch per dispatch
+        except BaseException as exc:  # noqa: BLE001
+            for p in entries:
+                p.error = exc
+                p.done.set()
+            return
+        for i, p in enumerate(entries):
+            row = arr[i]
+            p.outcome = PlaceOutcome(
+                rows=row[:, kernels.PACKED_ROW].astype(np.int32),
+                scores=row[:, kernels.PACKED_SCORE],
+                binpack=row[:, kernels.PACKED_BINPACK],
+                preempted=row[:, kernels.PACKED_PREEMPT] != 0.0,
+                nodes_evaluated=row[:, kernels.PACKED_EVALUATED].astype(
+                    np.int32
+                ),
+                nodes_filtered=row[:, kernels.PACKED_FILTERED].astype(
+                    np.int32
+                ),
+                nodes_exhausted=row[:, kernels.PACKED_EXHAUSTED].astype(
+                    np.int32
+                ),
+            )
+            p.done.set()

@@ -54,6 +54,35 @@ PLACEMENT_CHUNK = 16
 MAX_SELECT_RETRIES = 8
 
 
+def _dense_used0(arrays, deltas: Dict[int, np.ndarray]):
+    """Proposed base usage: matrix usage + sparse per-row plan deltas.
+    Device code — call on the device thread (dev_op closures)."""
+    import jax.numpy as jnp
+
+    used0 = arrays.used
+    if deltas:
+        rows = np.fromiter(deltas.keys(), np.int32)
+        dvals = np.stack([deltas[r] for r in rows])
+        used0 = used0.at[jnp.asarray(rows)].add(jnp.asarray(dvals))
+    return used0
+
+
+def _full_mask(n: int, host_mask: Optional[np.ndarray]) -> np.ndarray:
+    """host_mask with the all-pass default materialized."""
+    return host_mask if host_mask is not None else np.ones((n,), bool)
+
+
+def _pad_width(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    """Pad a node-axis array to width ``n`` — the matrix can grow between
+    building host inputs and the dev_op running on the device thread; new
+    rows get the conservative fill (False/0: not host-checked this round)."""
+    if arr.shape[0] >= n:
+        return arr
+    out = np.full((n,) + arr.shape[1:], fill, arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
 @dataclass
 class SelectionOption:
     """One placement decision (reference: rank.RankedNode)."""
@@ -381,12 +410,92 @@ class GenericStack:
     ) -> List[Optional[SelectionOption]]:
         """Place ``n_placements`` allocs of ``tg``; one option (or None) per
         requested placement (reference: stack.go:117-179 Select, called per
-        missing alloc from generic_sched.go:472)."""
-        # Whole selection holds the device lock: concurrent workers must not
-        # interleave kernel dispatch on the single-chip client (see
-        # state.matrix.DEVICE_LOCK).
+        missing alloc from generic_sched.go:472).
+
+        With a coalescer attached to the matrix (the live server), the
+        kernel call is batched with other workers' selects and this method
+        never touches the device directly; otherwise the whole selection
+        holds DEVICE_LOCK (tests, solo tools)."""
+        if getattr(self.matrix, "coalescer", None) is not None:
+            return self._select_locked(tg, n_placements, penalty_nodes)
         with DEVICE_LOCK:
             return self._select_locked(tg, n_placements, penalty_nodes)
+
+    # -- kernel dispatch (coalesced or solo) --------------------------------
+
+    def _dispatch_place(
+        self,
+        compiled: CompiledTaskGroup,
+        deltas: Dict[int, np.ndarray],
+        tg_count: np.ndarray,
+        spread_counts: np.ndarray,
+        penalty: np.ndarray,
+        class_elig: np.ndarray,
+        host_mask: Optional[np.ndarray],
+        remaining: int,
+    ):
+        """Run one placement scan; returns host-side arrays (rows, scores,
+        binpack, preempted, n_eval, n_filt, n_exh) of scan length ≥ the
+        bucket for ``remaining``."""
+        from .coalescer import MAX_DELTA_ROWS
+
+        # One consistent width for every per-node array in this request:
+        # re-reading matrix.capacity here could disagree with the shapes the
+        # caller built if a node registration grew the matrix mid-select.
+        n = tg_count.shape[0]
+        coal = getattr(self.matrix, "coalescer", None)
+        if coal is not None and len(deltas) <= MAX_DELTA_ROWS:
+            drows = np.full((MAX_DELTA_ROWS,), -1, np.int32)
+            dvals = np.zeros((MAX_DELTA_ROWS, 3), np.float32)
+            for i, (row, d) in enumerate(deltas.items()):
+                drows[i] = row
+                dvals[i] = d
+            out = coal.place(
+                compiled.request,
+                drows,
+                dvals,
+                tg_count,
+                spread_counts,
+                penalty,
+                class_elig,
+                _full_mask(n, host_mask),
+            )
+            return (
+                out.rows, out.scores, out.binpack, out.preempted,
+                out.nodes_evaluated, out.nodes_filtered, out.nodes_exhausted,
+            )
+
+        # Solo path: dense proposed usage, one direct dispatch.  With a
+        # coalescer present (live server) the closure still executes on ITS
+        # thread — the tunnel client wedges under concurrent device use.
+        def dev_op():
+            import jax.numpy as jnp
+
+            arrays = self.matrix.sync()
+            n_dev = int(arrays.used.shape[0])
+            bucket = min(_pow2_bucket(remaining), PLACEMENT_CHUNK)
+            result = kernels.place_task_group(
+                arrays,
+                compiled.request,
+                _dense_used0(arrays, deltas),
+                jnp.asarray(_pad_width(tg_count, n_dev, 0)),
+                jnp.asarray(spread_counts),
+                jnp.asarray(_pad_width(penalty, n_dev, False)),
+                jnp.asarray(class_elig),
+                jnp.asarray(_pad_width(_full_mask(n, host_mask), n_dev, False)),
+                n_placements=bucket,
+            )
+            return (
+                np.asarray(result.rows),
+                np.asarray(result.scores),
+                np.asarray(result.binpack),
+                np.asarray(result.preempted),
+                np.asarray(result.nodes_evaluated),
+                np.asarray(result.nodes_filtered),
+                np.asarray(result.nodes_exhausted),
+            )
+
+        return self.matrix.run_on_device(dev_op)
 
     def _select_locked(
         self,
@@ -406,7 +515,6 @@ class GenericStack:
             preemption_enabled=self.preemption_enabled,
         )
 
-        arrays = self.matrix.sync()
         n = self.matrix.capacity
 
         penalty = np.zeros((n,), bool)
@@ -418,8 +526,6 @@ class GenericStack:
         class_elig = self._class_eligibility(compiled)
         base_host_mask = self._host_mask(job, tg, compiled)
         self._record_eligibility(class_elig, base_host_mask)
-
-        import jax.numpy as jnp
 
         options: List[Optional[SelectionOption]] = []
         banned_rows: List[int] = []
@@ -442,11 +548,6 @@ class GenericStack:
             for row in chosen_rows:
                 d = deltas.setdefault(row, np.zeros(3, np.float32))
                 d += np.asarray(compiled.request.ask, np.float32)
-            used0 = arrays.used
-            if deltas:
-                rows = np.fromiter(deltas.keys(), np.int32)
-                dvals = np.stack([deltas[r] for r in rows])
-                used0 = used0.at[jnp.asarray(rows)].add(jnp.asarray(dvals))
 
             tg_counts = self._tg_counts(job, tg)
             for row in chosen_rows:
@@ -457,32 +558,19 @@ class GenericStack:
 
             spread_counts = self._spread_counts(job, tg, compiled)
 
-            # Fixed chunk ceiling keeps the set of lax.scan lengths (and thus
-            # jit compilations) bounded: {1,2,4,...,PLACEMENT_CHUNK} only.
-            bucket = min(_pow2_bucket(remaining), PLACEMENT_CHUNK)
-            result = kernels.place_task_group(
-                arrays,
-                compiled.request,
-                used0,
-                jnp.asarray(tg_count),
-                jnp.asarray(spread_counts),
-                jnp.asarray(penalty),
-                jnp.asarray(class_elig),
-                jnp.asarray(
-                    host_mask
-                    if host_mask is not None
-                    else np.ones((n,), bool)
-                ),
-                n_placements=bucket,
+            (rows_all, scores_all, binpack_all, preempted_all, n_eval_all,
+             n_filt_all, n_exh_all) = self._dispatch_place(
+                compiled, deltas, tg_count, spread_counts, penalty,
+                class_elig, host_mask, remaining,
             )
-            take = min(bucket, remaining)
-            rows_out = np.asarray(result.rows)[:take]
-            scores = np.asarray(result.scores)[:take]
-            binpack = np.asarray(result.binpack)[:take]
-            preempted = np.asarray(result.preempted)[:take]
-            n_eval = np.asarray(result.nodes_evaluated)[:take]
-            n_filt = np.asarray(result.nodes_filtered)[:take]
-            n_exh = np.asarray(result.nodes_exhausted)[:take]
+            take = min(len(rows_all), remaining)
+            rows_out = rows_all[:take]
+            scores = scores_all[:take]
+            binpack = binpack_all[:take]
+            preempted = preempted_all[:take]
+            n_eval = n_eval_all[:take]
+            n_filt = n_filt_all[:take]
+            n_exh = n_exh_all[:take]
 
             retry = False
             for i, row in enumerate(rows_out):
@@ -558,18 +646,11 @@ class SystemStack(GenericStack):
     feasible node, system_sched.go:22-54)."""
 
     def feasible_nodes(self, tg: TaskGroup) -> Tuple[List[str], AllocMetric]:
-        with DEVICE_LOCK:
-            return self._feasible_nodes_locked(tg)
-
-    def _feasible_nodes_locked(self, tg: TaskGroup) -> Tuple[List[str], AllocMetric]:
         assert self.job is not None
         job = self.job
         compiled = self.encoder.compile(
             job, tg, algorithm=self.algorithm, preemption_enabled=False
         )
-        arrays = self.matrix.sync()
-        import jax.numpy as jnp
-
         class_elig = self._class_eligibility(compiled)
         host_mask = self._host_mask(job, tg, compiled)
         self._record_eligibility(class_elig, host_mask)
@@ -588,24 +669,31 @@ class SystemStack(GenericStack):
             d = deltas.setdefault(row, np.zeros(3, np.float32))
             r = a.resources
             d -= np.array([r.cpu, r.memory_mb, r.disk_mb], np.float32)
-        used0 = arrays.used
-        if deltas:
-            rows = np.fromiter(deltas.keys(), np.int32)
-            dvals = np.stack([deltas[r] for r in rows])
-            used0 = used0.at[jnp.asarray(rows)].add(jnp.asarray(dvals))
 
-        mask, fits = kernels.system_feasible(
-            arrays,
-            used0,
-            compiled.request,
-            jnp.asarray(class_elig),
-            jnp.asarray(host_mask if host_mask is not None else np.ones((n,), bool)),
-        )
-        ok = np.asarray(mask & fits)
+        def dev_op():
+            import jax.numpy as jnp
+
+            arrays = self.matrix.sync()
+            n_dev = int(arrays.used.shape[0])
+            # One stacked (2, N) result = one device→host fetch (each
+            # separate fetch costs a tunnel round-trip).
+            return np.asarray(kernels.system_feasible(
+                arrays,
+                _dense_used0(arrays, deltas),
+                compiled.request,
+                jnp.asarray(class_elig),
+                jnp.asarray(
+                    _pad_width(_full_mask(n, host_mask), n_dev, False)
+                ),
+            ))
+
+        mf = self.matrix.run_on_device(dev_op)
+        mask, fits = mf[0], mf[1]
+        ok = mask & fits
         metric = AllocMetric(
-            nodes_evaluated=int(np.asarray(mask).sum()),
-            nodes_filtered=int((~np.asarray(mask)).sum()),
-            nodes_exhausted=int((np.asarray(mask) & ~np.asarray(fits)).sum()),
+            nodes_evaluated=int(mask.sum()),
+            nodes_filtered=int((~mask).sum()),
+            nodes_exhausted=int((mask & ~fits).sum()),
         )
         out = []
         for row in np.nonzero(ok)[0]:

@@ -70,6 +70,7 @@ class SystemScheduler:
 
         stack = SystemStack(ctx, self.matrix)
         stack.set_job(job)
+        self._stack = stack  # eligibility telemetry for blocked-eval keying
 
         live_by_node_tg: Dict[tuple, List[Allocation]] = {}
         for a in allocs:
@@ -79,6 +80,18 @@ class SystemScheduler:
         for tg in job.task_groups:
             feasible, metric = stack.feasible_nodes(tg)
             feasible_set = set(feasible)
+
+            # Feasible-but-exhausted nodes are reported as failures so the
+            # shortfall is visible (placed + failed = eligible nodes) and a
+            # blocked eval can retry when capacity frees (system_sched.go
+            # failedTGAllocs + queuedAllocs accounting).
+            if metric.nodes_exhausted > 0:
+                m = metric.copy()
+                m.coalesced_failures = metric.nodes_exhausted
+                self.failed_tg_allocs[tg.name] = m
+                self.queued_allocs[tg.name] = (
+                    self.queued_allocs.get(tg.name, 0) + metric.nodes_exhausted
+                )
 
             # Stop allocs on nodes no longer feasible / tainted.
             for (node_id, tg_name), node_allocs in list(live_by_node_tg.items()):
@@ -116,9 +129,17 @@ class SystemScheduler:
                     continue
                 ports = stack._assign_ports(node, tg)
                 if ports is None:
+                    # Port shortfall is a failed placement too: it must
+                    # reach failed_tg_allocs so a blocked eval parks and
+                    # retries when the conflicting alloc frees the port.
                     self.queued_allocs[tg.name] = (
                         self.queued_allocs.get(tg.name, 0) + 1
                     )
+                    m = self.failed_tg_allocs.get(tg.name)
+                    if m is None:
+                        m = metric.copy()
+                        self.failed_tg_allocs[tg.name] = m
+                    m.coalesced_failures += 1
                     continue
                 alloc = Allocation(
                     namespace=job.namespace,
@@ -163,4 +184,29 @@ class SystemScheduler:
         updated.status = EvalStatus.COMPLETE.value
         updated.queued_allocations = dict(self.queued_allocs)
         updated.failed_tg_allocs = dict(self.failed_tg_allocs)
+
+        # Exhausted/failed nodes park a blocked eval so the system job
+        # retries when capacity frees (system_sched.go:142-152; unblocked
+        # via BlockedEvals.unblock_node / class capacity events).
+        if self.failed_tg_allocs:
+            stack = getattr(self, "_stack", None)
+            blocked = Evaluation(
+                namespace=eval.namespace,
+                priority=eval.priority,
+                type=eval.type,
+                triggered_by="queued-allocs",
+                job_id=eval.job_id,
+                status=EvalStatus.BLOCKED.value,
+                status_description="created to place remaining system allocs",
+                previous_eval=eval.id,
+                snapshot_index=self.snapshot.snapshot_index,
+                class_eligibility=(
+                    dict(stack.class_eligibility) if stack else {}
+                ),
+                escaped_computed_class=(
+                    stack.escaped_computed_class if stack else True
+                ),
+            )
+            updated.blocked_eval = blocked.id
+            self.planner.create_evals([blocked])
         self.planner.update_eval(updated)

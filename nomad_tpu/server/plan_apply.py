@@ -9,9 +9,12 @@ the freshest state and commits (possibly partially), handing back a
 
 The reference fans per-node ``AllocsFit`` checks out to an EvaluatePool of
 NumCPU/2 goroutines (``plan_apply_pool.go:18``). Here the whole plan is
-verified in ONE ``verify_plan_fit`` kernel call against the authoritative
-device-resident matrix — the same arrays the scheduler scored against, which
-is the north-star "shared kernel" requirement.
+verified in ONE vectorized numpy pass against the authoritative matrix
+aggregates — the same data the scheduler's device kernels scored against
+(the north-star "shared semantics" requirement): the host math is the
+exact twin of the ``verify_plan_fit`` kernel, pinned together by
+tests/test_kernels.py golden tests.  The device is never touched while
+holding the store lock (a tunnel round-trip costs ~65ms).
 """
 
 from __future__ import annotations
@@ -21,7 +24,6 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..ops.kernels import verify_plan_fit
 from ..structs.types import (
     Allocation,
     NodeStatus,
@@ -244,27 +246,20 @@ class PlanApplier:
         if not checked:
             return failed
 
-        # Pad to a bucketed length so the jit cache stays warm across plans
-        # of different sizes (p99 budget: no recompiles on the hot path).
-        k = len(rows)
-        padded = 8
-        while padded < k:
-            padded *= 2
-        rows_arr = np.full(padded, -1, np.int32)
-        rows_arr[:k] = rows
-        deltas_arr = np.zeros((padded, 3), np.float32)
-        deltas_arr[:k] = np.stack(deltas)
-        elig_arr = np.zeros(padded, bool)
-        elig_arr[:k] = elig_required
-
-        from ..state.matrix import DEVICE_LOCK
-
-        with DEVICE_LOCK:
-            arrays = matrix.sync()
-            verdicts = np.asarray(
-                verify_plan_fit(arrays, rows_arr, deltas_arr, elig_arr)
-            )
-        for nid, ok in zip(checked, verdicts[:k]):
+        # Vectorized numpy verification over the authoritative aggregates —
+        # the exact host twin of the verify_plan_fit kernel (pinned together
+        # by tests/test_kernels.py::test_host_twin_matches_kernel).  The
+        # applier holds the global store lock here, and a device round-trip
+        # through the TPU tunnel costs ~65ms (bench.py rtt_floor_ms), so
+        # the device is never touched on this path; O(k) numpy handles any
+        # plan size in microseconds.
+        host = matrix.snapshot_host()
+        rows_np = np.asarray(rows, np.int32)
+        used = host["used"][rows_np] + np.stack(deltas)
+        fits = np.all(used <= host["totals"][rows_np], axis=1)
+        elig = host["eligible"][rows_np]
+        verdicts = fits & (~np.asarray(elig_required) | elig)
+        for nid, ok in zip(checked, verdicts):
             if not bool(ok):
                 failed.add(nid)
         return failed

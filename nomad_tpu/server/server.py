@@ -66,6 +66,8 @@ class ServerConfig:
     # Core GC cadence (reference: leader.go schedulePeriodic; intervals are
     # per-routine there, one shared interval here).
     core_gc_interval: float = 300.0
+    # Max selects batched into one device dispatch (scheduler/coalescer.py).
+    coalescer_lanes: int = 64
     scheduler_config: SchedulerConfiguration = field(
         default_factory=SchedulerConfiguration
     )
@@ -113,6 +115,15 @@ class Server:
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatcher(self)
 
+        # The matrix's single dispatch port: concurrent selects coalesce
+        # into batched kernel calls (scheduler/coalescer.py).
+        from ..scheduler.coalescer import DeviceCoalescer
+
+        self.coalescer = DeviceCoalescer(
+            self.matrix, max_lanes=self.config.coalescer_lanes
+        )
+        self.matrix.coalescer = self.coalescer
+
         self._index_lock = threading.Lock()
         self._index = 0
         self._last_gc = time.time()
@@ -146,6 +157,7 @@ class Server:
         self.blocked_evals.set_enabled(True)
         self.plan_queue.set_enabled(True)
         self.heartbeater.set_enabled(True)
+        self.coalescer.start()
         self.plan_applier.start()
         for w in self.workers:
             w.start()
@@ -186,6 +198,7 @@ class Server:
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()
+        self.coalescer.stop()
         self.eval_broker.shutdown()
         self.plan_queue.shutdown()
         self.heartbeater.set_enabled(False)

@@ -241,6 +241,29 @@ class DeviceArrays(NamedTuple):
     dyn_used: "jax.Array"  # (N,) i32 — ports consumed in the dynamic range
 
 
+_SCATTER_FN = None
+
+
+def _scatter_rows(device: "DeviceArrays", idx, *row_data) -> "DeviceArrays":
+    """Jitted multi-field row scatter (lazy so importing nomad_tpu doesn't
+    initialize a jax backend). Numpy operands transfer as part of the one
+    dispatch — the cheap path through a high-latency tunnel."""
+    global _SCATTER_FN
+    if _SCATTER_FN is None:
+        import jax
+
+        def scat(d, i, *vals):
+            return DeviceArrays(
+                **{
+                    f: getattr(d, f).at[i].set(v)
+                    for f, v in zip(DeviceArrays._fields, vals)
+                }
+            )
+
+        _SCATTER_FN = jax.jit(scat)
+    return _SCATTER_FN(device, idx, *row_data)
+
+
 class NodeMatrix:
     """Host mirror + device copy of the cluster matrix.
 
@@ -264,6 +287,12 @@ class NodeMatrix:
         self._dirty: set = set()
         self._device: Optional[DeviceArrays] = None
         self._device_valid = False
+        # Guards _alloc row writes + _dirty against the sync drain: store
+        # mutators run under the store lock, sync under DEVICE_LOCK — with
+        # no common lock, a row marked dirty while sync snapshots the set
+        # was cleared WITHOUT ever reaching the device, leaving (e.g.) a
+        # freshly registered node invisible to every subsequent dispatch.
+        self._host_lock = threading.Lock()
 
     # -- host arrays --------------------------------------------------------
 
@@ -322,6 +351,10 @@ class NodeMatrix:
 
         Usage columns are owned by the alloc-delta path.
         """
+        with self._host_lock:
+            return self._upsert_node_locked(node)
+
+    def _upsert_node_locked(self, node: Node) -> int:
         row = self._claim_row(node.id)
         a = self._alloc
         avail = node.comparable_resources()
@@ -370,13 +403,18 @@ class NodeMatrix:
         return row
 
     def set_eligibility(self, node_id: str, eligible: bool) -> None:
-        row = self.row_of.get(node_id)
-        if row is None:
-            return
-        self._alloc["eligible"][row] = eligible
-        self._dirty.add(row)
+        with self._host_lock:
+            row = self.row_of.get(node_id)
+            if row is None:
+                return
+            self._alloc["eligible"][row] = eligible
+            self._dirty.add(row)
 
     def remove_node(self, node_id: str) -> None:
+        with self._host_lock:
+            self._remove_node_locked(node_id)
+
+    def _remove_node_locked(self, node_id: str) -> None:
         row = self.row_of.pop(node_id, None)
         if row is None:
             return
@@ -441,6 +479,14 @@ class NodeMatrix:
 
     def add_alloc(self, alloc: Allocation) -> None:
         """Account a (non-terminal) allocation's usage on its node."""
+        with self._host_lock:
+            self._add_alloc_locked(alloc)
+
+    def remove_alloc(self, alloc: Allocation) -> None:
+        with self._host_lock:
+            self._remove_alloc_locked(alloc)
+
+    def _add_alloc_locked(self, alloc: Allocation) -> None:
         row = self.row_of.get(alloc.node_id)
         if row is None:
             return
@@ -454,7 +500,7 @@ class NodeMatrix:
         self._port_delta(row, alloc, claim=True)
         self._dirty.add(row)
 
-    def remove_alloc(self, alloc: Allocation) -> None:
+    def _remove_alloc_locked(self, alloc: Allocation) -> None:
         row = self.row_of.get(alloc.node_id)
         if row is None:
             return
@@ -475,6 +521,20 @@ class NodeMatrix:
 
     # -- device sync --------------------------------------------------------
 
+    def run_on_device(self, fn):
+        """Execute a device-touching closure on THE device thread.
+
+        The single invariant point for device access: with a coalescer
+        attached (the live server) the closure runs on its dispatch
+        thread; otherwise inline under DEVICE_LOCK.  Call sites must not
+        take DEVICE_LOCK and dispatch themselves — the single-chip tunnel
+        client wedges under concurrent host threads."""
+        coal = getattr(self, "coalescer", None)
+        if coal is not None:
+            return coal.run_device_op(fn)
+        with DEVICE_LOCK:
+            return fn()
+
     def snapshot_host(self) -> Dict[str, np.ndarray]:
         """Host-side view (no copy) of the active arrays."""
         return self._alloc
@@ -489,31 +549,58 @@ class NodeMatrix:
             return self._sync_locked()
 
     def _sync_locked(self) -> DeviceArrays:
-        import jax.numpy as jnp
+        import jax
 
-        # Host array keys match DeviceArrays field names 1:1, so both the
-        # full upload and the dirty-row scatter are field-generic.
+        # Snapshot the dirty rows' data under the host lock (mutators may
+        # run concurrently from the store); the device transfer itself
+        # happens outside it.  `_alloc[f][rows]` fancy-indexing copies.
         if self._device is None or not self._device_valid:
-            self._device = DeviceArrays(
-                **{f: jnp.asarray(self._alloc[f]) for f in DeviceArrays._fields}
-            )
-            self._device_valid = True
-            self._dirty.clear()
+            with self._host_lock:
+                host_copy = {
+                    f: self._alloc[f].copy() for f in DeviceArrays._fields
+                }
+                self._dirty.clear()
+                # Claim validity for THIS copy while still under the lock:
+                # a concurrent _grow after this point flips it back to
+                # False and the next sync re-uploads — setting it after
+                # the transfer would clobber that invalidation and leave
+                # post-growth rows silently out of device bounds.
+                self._device_valid = True
+            try:
+                # One pytree transfer, not 12 per-field round-trips.
+                dev = jax.device_put(host_copy)
+                self._device = DeviceArrays(
+                    **{f: dev[f] for f in DeviceArrays._fields}
+                )
+            except BaseException:
+                # Failed transfer must not strand the cleared dirty set —
+                # invalidate so the next sync re-uploads everything.
+                self._device_valid = False
+                raise
             return self._device
 
-        if self._dirty:
+        with self._host_lock:
+            if not self._dirty:
+                return self._device
             rows = np.fromiter(self._dirty, np.int32)
-            idx = jnp.asarray(rows)
-            d = self._device
-            self._device = DeviceArrays(
-                **{
-                    f: getattr(d, f).at[idx].set(
-                        jnp.asarray(self._alloc[f][rows])
-                    )
-                    for f in DeviceArrays._fields
-                }
-            )
             self._dirty.clear()
+            # Pad the row count to a pow2 bucket (repeating row 0 — the
+            # duplicate scatter writes identical data) so the jitted
+            # scatter compiles once per bucket; the numpy operands ride
+            # the dispatch instead of paying a dozen per-field transfer
+            # round-trips (measured 232ms → 81ms per sync on the tunnel).
+            k = len(rows)
+            padded = 1 << max(0, (k - 1)).bit_length()
+            idx = np.full((padded,), rows[0], np.int32)
+            idx[:k] = rows
+            row_data = [self._alloc[f][idx] for f in DeviceArrays._fields]
+        try:
+            self._device = _scatter_rows(self._device, idx, *row_data)
+        except BaseException:
+            # Put the drained rows back so a later sync retries them.
+            with self._host_lock:
+                self._dirty.update(int(r) for r in rows)
+            raise
         return self._device
 
     def invalidate(self) -> None:

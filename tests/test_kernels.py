@@ -337,6 +337,92 @@ class TestVerifyPlanFit:
         assert bool(ok[1])
         assert bool(ok[2])  # padding passes
 
+    def test_host_twin_matches_kernel(self):
+        """The plan applier's host fast path (plan_apply._evaluate) must be
+        bit-identical to verify_plan_fit over the same aggregates."""
+        rng = np.random.default_rng(3)
+        nodes = [
+            make_node(cpu=int(c), mem=int(mm))
+            for c, mm in rng.integers(500, 8000, (12, 2))
+        ]
+        m = setup(nodes)
+        for n in nodes[:6]:
+            m.add_alloc(Allocation(node_id=n.id, job=Job(), resources=(
+                Resources(cpu=int(rng.integers(100, 2000)),
+                          memory_mb=int(rng.integers(100, 2000))))))
+        m.snapshot_host()["eligible"][3] = False
+        m._dirty.add(3)
+        arrays = m.sync()
+        host = m.snapshot_host()
+
+        k = 12
+        rows = np.arange(k, dtype=np.int32)
+        deltas = rng.uniform(0, 4000, (k, 3)).astype(np.float32)
+        elig_required = rng.random(k) < 0.5
+
+        kernel = np.asarray(verify_plan_fit(
+            arrays, jnp.asarray(rows), jnp.asarray(deltas),
+            jnp.asarray(elig_required),
+        ))
+        used = host["used"][rows] + deltas
+        fits = np.all(used <= host["totals"][rows], axis=1)
+        host_v = fits & (~elig_required | host["eligible"][rows])
+        assert (kernel == host_v).all()
+
+
+class TestPlaceBatch:
+    def test_matches_solo_scan(self):
+        """place_batch (the coalescer kernel) must equal per-request
+        place_task_group runs, including sparse delta application."""
+        from nomad_tpu.ops.encode import MAX_SPREADS, MAX_SPREAD_VALUES
+        from nomad_tpu.ops.kernels import place_batch
+
+        nodes = [make_node(cpu=2000 + 500 * i, mem=4096) for i in range(6)]
+        m = setup(nodes)
+        jobs = [make_job(cpu=300 + 100 * i, mem=256) for i in range(3)]
+        enc = RequestEncoder(m)
+        compiled = [enc.compile(j, j.task_groups[0]) for j in jobs]
+        arrays = m.sync()
+        n = arrays.used.shape[0]
+
+        scan_len = 4
+        drows = np.full((3, 8), -1, np.int32)
+        dvals = np.zeros((3, 8, 3), np.float32)
+        # Request 1 carries an in-flight delta on row 5.
+        drows[1, 0] = 5
+        dvals[1, 0] = [1500.0, 0.0, 0.0]
+
+        import jax
+
+        reqs = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *[c.request for c in compiled]
+        )
+        zeros_tg = np.zeros((3, n), np.int32)
+        zeros_sc = np.zeros((3, MAX_SPREADS, MAX_SPREAD_VALUES), np.float32)
+        zeros_pen = np.zeros((3, n), bool)
+        ones_ce = np.ones((3, 2), bool)
+        ones_hm = np.ones((3, n), bool)
+        packed = np.asarray(place_batch(
+            arrays, arrays.used, drows, dvals, zeros_tg, zeros_sc,
+            zeros_pen, reqs, ones_ce, ones_hm, n_placements=scan_len,
+        ))
+
+        for i, c in enumerate(compiled):
+            used0 = arrays.used
+            if i == 1:
+                used0 = used0.at[5].add(jnp.asarray([1500.0, 0.0, 0.0]))
+            solo = place_task_group(
+                arrays, c.request, used0, jnp.zeros((n,), jnp.int32),
+                jnp.zeros((MAX_SPREADS, MAX_SPREAD_VALUES), jnp.float32),
+                jnp.zeros((n,), bool), jnp.ones((2,), bool),
+                jnp.ones((n,), bool), scan_len,
+            )
+            assert (packed[i, :, 0].astype(np.int32)
+                    == np.asarray(solo.rows)).all()
+            np.testing.assert_allclose(
+                packed[i, :, 1], np.asarray(solo.scores), rtol=1e-5
+            )
+
 
 class TestEncodingEscapes:
     def test_version_two_component_attr(self):

@@ -295,6 +295,32 @@ def test_replay_preserves_wallclock_stamps(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# Blocked evals unblock when an in-process client registers (the client
+# mutated the shared Node object before the ready-status update, so the
+# server never saw an init→ready transition and skipped _capacity_added)
+# ----------------------------------------------------------------------
+
+
+def test_blocked_evals_unblock_on_client_registration(server, tmp_path):
+    jobs = [_small(mock.job()) for _ in range(3)]
+    for j in jobs:
+        j.task_groups[0].count = 2
+    evals = [server.submit_job(j) for j in jobs]
+    for ev in evals:
+        server.wait_for_eval(ev.id, timeout=60)
+    assert server.blocked_evals.blocked_count() == 3
+
+    c = _client(server, tmp_path, "c1")
+    try:
+        assert _wait(lambda: all(
+            len(server.store.allocs_by_job(j.namespace, j.id)) > 0
+            for j in jobs
+        ), timeout=30), f"blocked={server.blocked_evals.blocked_count()}"
+    finally:
+        c.shutdown()
+
+
+# ----------------------------------------------------------------------
 # 5. Event stream: gapped backlog is signalled, not silent
 # ----------------------------------------------------------------------
 
