@@ -312,9 +312,9 @@ def bench_e2e(result: dict) -> None:
 def _run_e2e(srv, result: dict) -> None:
     from nomad_tpu import mock
 
-    # 10K TTL timers would mean 10K timer threads; the bench isn't about
-    # failure detection, so disarm heartbeats before mass registration.
-    srv.heartbeater.set_enabled(False)
+    # Heartbeats stay ARMED: the heap-driven wheel serves 10K nodes from
+    # one thread (the old per-node threading.Timer design needed disarming
+    # at this scale).
     rng = np.random.default_rng(7)
     for i in range(N_NODES):
         node = mock.node()

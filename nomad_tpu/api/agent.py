@@ -43,9 +43,17 @@ class Agent:
             if self.server is not None:
                 server_handle = self.server
             elif self.config.server_addr:
-                from .rpc import HTTPServerRPC
+                from .rpc import FailoverRPC, HTTPServerRPC
 
-                server_handle = HTTPServerRPC(self.config.server_addr)
+                addrs = [
+                    a.strip()
+                    for a in self.config.server_addr.split(",")
+                    if a.strip()
+                ]
+                server_handle = (
+                    FailoverRPC(addrs) if len(addrs) > 1
+                    else HTTPServerRPC(addrs[0])
+                )
             else:
                 raise ValueError(
                     "client-only agents need --servers <addr> of a server agent"
@@ -59,6 +67,10 @@ class Agent:
             self, host=self.config.http_host, port=self.config.http_port
         )
         self.rpc_addr = self.http.addr
+        if self.server is not None and self.config.server_config.peers:
+            # Multi-server: join the peer set as a follower; the election
+            # promotes one leader (server/replication.py).
+            self.server.setup_replication(self.rpc_addr)
 
     def start(self) -> None:
         self.started_at = time.time()

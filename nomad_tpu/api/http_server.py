@@ -79,7 +79,14 @@ class HTTPAPIServer:
                 except HTTPError as exc:
                     self._respond(exc.code, {"error": exc.message})
                 except Exception as exc:  # noqa: BLE001
-                    self._respond(500, {"error": str(exc)})
+                    from ..server.replication import NotLeaderError
+
+                    if isinstance(exc, NotLeaderError):
+                        self._respond(409, {
+                            "error": f"not leader; leader={exc.leader_addr}"
+                        })
+                    else:
+                        self._respond(500, {"error": str(exc)})
 
             def do_GET(self):
                 self._handle("GET")
@@ -160,6 +167,34 @@ class HTTPAPIServer:
         if server is None:
             raise HTTPError(501, "agent is not running a server")
         store = server.store
+
+        # ---- consensus stream (server↔server; replication.py) ----
+        if path.startswith("/v1/internal/raft/"):
+            rep = store.replicator
+            if rep is None:
+                raise HTTPError(501, "server is not running replication")
+            if path == "/v1/internal/raft/append":
+                return rep.handle_append(body or {})
+            if path == "/v1/internal/raft/vote":
+                return rep.handle_vote(body or {})
+            if path == "/v1/internal/raft/snapshot":
+                return rep.handle_snapshot_install(body or {})
+            if path == "/v1/internal/raft/stats":
+                return rep.stats()
+            raise HTTPError(404, f"unknown raft RPC {path}")
+
+        # ---- leader gate: writes (and node RPCs) only serve on the leader
+        # (the reference forwards to the leader, nomad/rpc.go forward; we
+        # redirect — FailoverRPC/CLI follow the hint) ----
+        rep = store.replicator
+        if rep is not None and not rep.is_leader:
+            is_write = method in ("PUT", "POST", "DELETE") and path not in (
+                "/v1/jobs/parse",
+            )
+            if is_write or path.startswith("/v1/internal/"):
+                raise HTTPError(
+                    409, f"not leader; leader={rep.leader_addr}"
+                )
 
         # ---- internal node RPCs (client↔server wire; api/rpc.py peer) ----
         if path.startswith("/v1/internal/"):

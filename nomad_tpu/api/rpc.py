@@ -19,9 +19,11 @@ response open server-side (the memdb WatchSet discipline over the wire).
 from __future__ import annotations
 
 import json
+import re
+import time
 import urllib.error
 import urllib.request
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from ..structs import serde
 from ..structs.types import Allocation, Node
@@ -99,3 +101,65 @@ class HTTPServerRPC:
             "/v1/internal/node/update-allocs",
             {"Allocs": [serde.to_wire(a) for a in updates]},
         )
+
+
+# The hint travels inside a JSON error body — stop before quote/brace.
+_LEADER_HINT = re.compile(r"leader=([^\s\"'}]+)")
+
+
+class FailoverRPC:
+    """The client's handle to a multi-server control plane.
+
+    Wraps one :class:`HTTPServerRPC` per server address; every call tries
+    the current target and, on connection errors or a ``not leader``
+    redirect (409 with a ``leader=<addr>`` hint), retargets and retries —
+    the client-side half of failover (the reference's client tracks a
+    server list from heartbeats and rotates on RPC errors,
+    client/servers/manager.go).
+    """
+
+    def __init__(self, addrs: List[str], timeout: float = 10.0):
+        assert addrs, "need at least one server address"
+        self.rpcs = {a: HTTPServerRPC(a, timeout=timeout) for a in addrs}
+        self.addrs = list(addrs)
+        self.current = self.addrs[0]
+
+    def _retarget(self, err: RPCError) -> None:
+        hint = _LEADER_HINT.search(str(err))
+        if hint and hint.group(1) in self.rpcs:
+            self.current = hint.group(1)
+            return
+        idx = self.addrs.index(self.current)
+        self.current = self.addrs[(idx + 1) % len(self.addrs)]
+
+    def _with_failover(self, fn_name: str, *args, **kwargs):
+        last: Optional[RPCError] = None
+        for _ in range(2 * len(self.addrs)):
+            try:
+                return getattr(self.rpcs[self.current], fn_name)(
+                    *args, **kwargs
+                )
+            except RPCError as exc:
+                last = exc
+                self._retarget(exc)
+                time.sleep(0.05)
+        raise last  # type: ignore[misc]
+
+    def register_node(self, node: Node) -> float:
+        return self._with_failover("register_node", node)
+
+    def heartbeat_node(self, node_id: str) -> float:
+        return self._with_failover("heartbeat_node", node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        return self._with_failover("update_node_status", node_id, status)
+
+    def get_client_allocs(
+        self, node_id: str, min_index: int = 0, timeout: float = 30.0
+    ) -> Tuple[List[Allocation], int]:
+        return self._with_failover(
+            "get_client_allocs", node_id, min_index=min_index, timeout=timeout
+        )
+
+    def update_allocs_from_client(self, updates: List[Allocation]) -> None:
+        return self._with_failover("update_allocs_from_client", updates)

@@ -45,6 +45,9 @@ class ClientConfig:
     meta: Dict[str, str] = field(default_factory=dict)
     # Fraction of the granted TTL at which to heartbeat (client sends early).
     heartbeat_factor: float = 0.5
+    # Client GC (client/gc.go): keep at most this many terminal alloc dirs;
+    # the oldest are evicted (runner destroyed, dir removed, state dropped).
+    max_terminal_allocs: int = 50
 
 
 class Client:
@@ -217,6 +220,31 @@ class Client:
             elif alloc.modify_index > ar.alloc.modify_index:
                 ar.update(alloc)
                 self._persist(ar)
+
+        self._gc_terminal_allocs()
+
+    def _gc_terminal_allocs(self) -> None:
+        """Evict the oldest terminal AllocRunners past the budget so
+        finished allocs don't accumulate dirs/state forever (client/gc.go
+        AllocCounter eviction; disk/inode pressure trimmed to a count
+        budget here)."""
+        budget = self.config.max_terminal_allocs
+        with self._lock:
+            terminal = [
+                (ar.alloc.modify_index, aid)
+                for aid, ar in self.allocs.items()
+                # Never evict before the final status update shipped.
+                if ar.terminal and aid not in self._dirty
+            ]
+        if len(terminal) <= budget:
+            return
+        terminal.sort()
+        for _, aid in terminal[: len(terminal) - budget]:
+            with self._lock:
+                ar = self.allocs.pop(aid, None)
+            if ar is not None:
+                ar.destroy()
+                self.state_db.delete_alloc(aid)
 
     # ------------------------------------------------------------------
 

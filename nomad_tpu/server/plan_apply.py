@@ -50,6 +50,8 @@ class PlanApplier:
         self.plans_partial = 0
 
     def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # leadership can cycle; one applier thread only
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, name="plan-applier", daemon=True

@@ -1,0 +1,446 @@
+"""Raft-lite replicated control plane: WAL streaming, election, failover.
+
+Reference: the reference replicates all state writes through hashicorp/raft
+(``nomad/fsm.go``, ``nomad/raft_rpc.go:1-134``) and drives leader-only
+services from election transitions (``monitorLeadership``,
+``nomad/leader.go:54-222``).
+
+This build replicates the same ``(index, seq, op, args)`` entry stream the
+WAL already journals (state/wal.py) over the existing HTTP wire:
+
+- **Log replication.** The leader appends locally, then ships the entry to
+  every peer and blocks for a majority of acks before the write returns.
+  An acknowledged write therefore exists on a quorum; an unacknowledged
+  write may be lost on failover but its submitter saw an error — the
+  primary-backup variant of raft's commit rule.
+- **Election.** Term-based voting with randomized timeouts. A vote is
+  granted only to candidates whose log is at least as long (``last_seq``),
+  so any winner holds every majority-acked entry (the vote majority and
+  the ack majority intersect — raft's safety argument, §5.4.1 of the
+  paper, applied to the seq axis).
+- **Catch-up.** A follower whose ``last_seq`` doesn't match the stream
+  requests a full snapshot install (``StateStore.to_snapshot_wire`` — the
+  FSM image the WAL already knows how to persist/restore).
+- **Transitions.** Winning an election calls
+  ``server.establish_leadership()`` (brokers, workers, watchers, timers);
+  observing a higher term calls ``server.revoke_leadership()``.
+
+Writes on non-leaders raise :class:`NotLeaderError` carrying the leader's
+address; ``api.rpc.FailoverRPC`` follows the hint so clients survive
+failovers transparently.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_addr: str = ""):
+        super().__init__(
+            f"not the leader{f' (leader at {leader_addr})' if leader_addr else ''}"
+        )
+        self.leader_addr = leader_addr
+
+
+class ReplicationError(Exception):
+    """A write could not reach a quorum — it is NOT committed."""
+
+
+@dataclass
+class PeerState:
+    addr: str
+    healthy: bool = True
+    last_error: str = ""
+    # Failed peers are skipped by the write path until this monotonic
+    # time; the heartbeat loop keeps probing and clears it on success, so
+    # one dead peer costs writes a single timeout per cooldown window
+    # instead of one per write.
+    retry_after: float = 0.0
+
+
+class Replicator:
+    """One per server; owns role/term state and the peer stream."""
+
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+    def __init__(
+        self,
+        server,
+        server_id: str,
+        self_addr: str,
+        peer_addrs: List[str],
+        election_timeout: tuple = (0.25, 0.5),
+        heartbeat_interval: float = 0.08,
+        rpc_timeout: float = 5.0,
+        append_timeout: float = 1.5,
+        peer_cooldown: float = 0.5,
+    ):
+        self.server = server
+        self.id = server_id
+        self.self_addr = self_addr
+        self.peers: Dict[str, PeerState] = {
+            a: PeerState(addr=a) for a in peer_addrs if a and a != self_addr
+        }
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.rpc_timeout = rpc_timeout
+        self.append_timeout = append_timeout
+        self.peer_cooldown = peer_cooldown
+
+        self._lock = threading.RLock()
+        self.role = self.FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_id: Optional[str] = None
+        self.leader_addr: str = ""
+        # Log position: mirrors the WAL sequence (authoritative when a WAL
+        # is attached; tracked here for diskless test servers).
+        wal = server.store.wal
+        self.last_seq = wal.seq if wal is not None else 0
+        self._last_heartbeat = time.monotonic()
+
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        t = threading.Thread(
+            target=self._election_loop, name=f"raft-election-{self.id}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == self.LEADER
+
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    def ensure_leader(self) -> None:
+        if not self.is_leader:
+            raise NotLeaderError(self.leader_addr)
+
+    # ------------------------------------------------------------------
+    # Peer RPC plumbing (HTTP; the same wire the agents already speak)
+    # ------------------------------------------------------------------
+
+    def _post(
+        self, addr: str, path: str, payload: Dict,
+        timeout: Optional[float] = None,
+    ) -> Dict:
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            addr + path, data=data, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(
+            req, timeout=timeout or self.rpc_timeout
+        ) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    # ------------------------------------------------------------------
+    # Leader: entry replication (called from the store's journal hook)
+    # ------------------------------------------------------------------
+
+    def replicate(self, entry: Dict) -> None:
+        """Ship one journaled entry to the peers; block for quorum-1 acks
+        (the leader's own durable append is the +1).  Raises
+        :class:`ReplicationError` when a quorum is unreachable — the write
+        must fail rather than be acknowledged uncommitted."""
+        with self._lock:
+            if self.role != self.LEADER:
+                raise NotLeaderError(self.leader_addr)
+            term = self.term
+            prev_seq = self.last_seq
+            self.last_seq = entry["s"]
+        if not self.peers:
+            return
+        acks = 1  # self
+        needed = self.quorum()
+        # Concurrent posts (not sequential — the caller holds the store
+        # lock, so per-write latency is max(RTT) not sum); peers in their
+        # failure cooldown are skipped outright.
+        now = time.monotonic()
+        eligible = [
+            p for p in self.peers.values() if now >= p.retry_after
+        ]
+        results: Dict[str, bool] = {}
+
+        def send(p: PeerState) -> None:
+            results[p.addr] = self._send_entries(p, term, prev_seq, [entry])
+
+        threads = [
+            threading.Thread(target=send, args=(p,), daemon=True)
+            for p in eligible
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.append_timeout + 1.0)
+        acks += sum(1 for ok in results.values() if ok)
+        if acks < needed:
+            # Lost quorum: step down so an up-to-date peer can take over.
+            self._step_down(term, reason="lost replication quorum")
+            raise ReplicationError(
+                f"entry seq={entry['s']} acked by {acks}/{needed} servers"
+            )
+
+    def _send_entries(
+        self, peer: PeerState, term: int, prev_seq: int, entries: List[Dict]
+    ) -> bool:
+        try:
+            out = self._post(peer.addr, "/v1/internal/raft/append", {
+                "Term": term,
+                "LeaderID": self.id,
+                "LeaderAddr": self.self_addr,
+                "PrevSeq": prev_seq,
+                "Entries": entries,
+            }, timeout=self.append_timeout)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
+            peer.healthy = False
+            peer.last_error = str(exc)
+            peer.retry_after = time.monotonic() + self.peer_cooldown
+            return False
+        if out.get("Term", 0) > term:
+            self._observe_term(out["Term"])
+            return False
+        if out.get("NeedSnapshot"):
+            return self._install_snapshot(peer, term)
+        peer.healthy = bool(out.get("OK"))
+        if peer.healthy:
+            peer.retry_after = 0.0
+        return peer.healthy
+
+    def _install_snapshot(self, peer: PeerState, term: int) -> bool:
+        """Catch a lagging/diverged follower up with the full FSM image
+        (fsm.go:1367 Persist / raft InstallSnapshot analog)."""
+        store = self.server.store
+        with store._lock:
+            snap = store.to_snapshot_wire()
+            seq = self.last_seq
+        try:
+            out = self._post(peer.addr, "/v1/internal/raft/snapshot", {
+                "Term": term,
+                "LeaderID": self.id,
+                "LeaderAddr": self.self_addr,
+                "Seq": seq,
+                "Snapshot": snap,
+            })
+            ok = bool(out.get("OK"))
+            peer.healthy = ok
+            if ok:
+                log.info("installed snapshot (seq=%d) on %s", seq, peer.addr)
+            return ok
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
+            peer.healthy = False
+            peer.last_error = str(exc)
+            return False
+
+    # ------------------------------------------------------------------
+    # Follower: stream handlers (HTTP endpoints route here)
+    # ------------------------------------------------------------------
+
+    def handle_append(self, body: Dict) -> Dict:
+        term = int(body.get("Term", 0))
+        with self._lock:
+            if term < self.term:
+                return {"OK": False, "Term": self.term}
+            self._observe_leader_locked(
+                term, body.get("LeaderID", ""), body.get("LeaderAddr", "")
+            )
+            entries = body.get("Entries", [])
+            if int(body.get("PrevSeq", 0)) != self.last_seq:
+                return {
+                    "OK": False, "Term": self.term, "NeedSnapshot": True,
+                    "Seq": self.last_seq,
+                }
+            for e in entries:
+                self.server.store.apply_remote(e)
+                self.last_seq = e["s"]
+            return {"OK": True, "Term": self.term, "Seq": self.last_seq}
+
+    def handle_snapshot_install(self, body: Dict) -> Dict:
+        term = int(body.get("Term", 0))
+        with self._lock:
+            if term < self.term:
+                return {"OK": False, "Term": self.term}
+            self._observe_leader_locked(
+                term, body.get("LeaderID", ""), body.get("LeaderAddr", "")
+            )
+            self.server.store.install_snapshot(
+                body["Snapshot"], int(body.get("Seq", 0))
+            )
+            self.last_seq = int(body.get("Seq", 0))
+            return {"OK": True, "Term": self.term}
+
+    def handle_vote(self, body: Dict) -> Dict:
+        term = int(body.get("Term", 0))
+        candidate = body.get("CandidateID", "")
+        cand_seq = int(body.get("LastSeq", 0))
+        with self._lock:
+            if term < self.term:
+                return {"Granted": False, "Term": self.term}
+            if term > self.term:
+                self._new_term_locked(term)
+            up_to_date = cand_seq >= self.last_seq
+            grant = self.voted_for in (None, candidate) and up_to_date
+            if grant:
+                self.voted_for = candidate
+                self._last_heartbeat = time.monotonic()
+            return {"Granted": grant, "Term": self.term}
+
+    # ------------------------------------------------------------------
+    # Role transitions
+    # ------------------------------------------------------------------
+
+    def _observe_leader_locked(
+        self, term: int, leader_id: str, leader_addr: str
+    ) -> None:
+        if term > self.term:
+            self._new_term_locked(term)
+        if self.role != self.FOLLOWER:
+            self._become_follower_locked()
+        self.leader_id = leader_id
+        self.leader_addr = leader_addr
+        self._last_heartbeat = time.monotonic()
+
+    def _observe_term(self, term: int) -> None:
+        with self._lock:
+            if term > self.term:
+                self._new_term_locked(term)
+                self._become_follower_locked()
+
+    def _new_term_locked(self, term: int) -> None:
+        self.term = term
+        self.voted_for = None
+
+    def _become_follower_locked(self) -> None:
+        was_leader = self.role == self.LEADER
+        self.role = self.FOLLOWER
+        if was_leader:
+            log.info("%s: stepping down (term %d)", self.id, self.term)
+            threading.Thread(
+                target=self.server.revoke_leadership, daemon=True
+            ).start()
+
+    def _step_down(self, term: int, reason: str) -> None:
+        with self._lock:
+            if self.role == self.LEADER and self.term == term:
+                log.warning("%s: %s", self.id, reason)
+                self._become_follower_locked()
+
+    def _become_leader(self, term: int) -> None:
+        with self._lock:
+            if self.term != term or self.role != self.CANDIDATE:
+                return
+            self.role = self.LEADER
+            self.leader_id = self.id
+            self.leader_addr = self.self_addr
+        log.info("%s: elected leader (term %d, seq %d)",
+                 self.id, term, self.last_seq)
+        t = threading.Thread(
+            target=self._heartbeat_loop, args=(term,),
+            name=f"raft-heartbeat-{self.id}", daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+        self.server.establish_leadership()
+
+    # ------------------------------------------------------------------
+    # Timers
+    # ------------------------------------------------------------------
+
+    def _election_loop(self) -> None:
+        while not self._stop.is_set():
+            timeout = random.uniform(*self.election_timeout)
+            self._stop.wait(timeout / 4)
+            with self._lock:
+                role = self.role
+                stale = time.monotonic() - self._last_heartbeat > timeout
+            if role != self.LEADER and stale:
+                self._campaign()
+
+    def _campaign(self) -> None:
+        with self._lock:
+            self.term += 1
+            term = self.term
+            self.role = self.CANDIDATE
+            self.voted_for = self.id
+            self._last_heartbeat = time.monotonic()
+            last_seq = self.last_seq
+        votes = 1
+        for peer in list(self.peers.values()):
+            try:
+                out = self._post(peer.addr, "/v1/internal/raft/vote", {
+                    "Term": term,
+                    "CandidateID": self.id,
+                    "LastSeq": last_seq,
+                })
+            except (urllib.error.URLError, OSError, json.JSONDecodeError):
+                continue
+            if out.get("Term", 0) > term:
+                self._observe_term(out["Term"])
+                return
+            if out.get("Granted"):
+                votes += 1
+        if votes >= self.quorum():
+            self._become_leader(term)
+
+    def _heartbeat_loop(self, term: int) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                if self.role != self.LEADER or self.term != term:
+                    return
+                prev_seq = self.last_seq
+            alive = 1
+            for peer in list(self.peers.values()):
+                if self._send_entries(peer, term, prev_seq, []):
+                    alive += 1
+            if alive < self.quorum():
+                # Can't reach a quorum: stop acting as leader so a
+                # connected majority can elect (and our stale writes fail).
+                self._step_down(term, reason="lost heartbeat quorum")
+                return
+            self._stop.wait(self.heartbeat_interval)
+
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "ID": self.id,
+                "Role": self.role,
+                "Term": self.term,
+                "LastSeq": self.last_seq,
+                "LeaderID": self.leader_id or "",
+                "LeaderAddr": self.leader_addr,
+                "Peers": {
+                    a: {"Healthy": p.healthy, "LastError": p.last_error}
+                    for a, p in self.peers.items()
+                },
+            }

@@ -68,6 +68,12 @@ class ServerConfig:
     core_gc_interval: float = 300.0
     # Max selects batched into one device dispatch (scheduler/coalescer.py).
     coalescer_lanes: int = 64
+    # Multi-server consensus (server/replication.py): peer HTTP addresses.
+    # Empty = single-server (immediate leadership, no replication).
+    server_id: str = ""
+    peers: List[str] = field(default_factory=list)
+    election_timeout: tuple = (0.25, 0.5)
+    raft_heartbeat_interval: float = 0.08
     scheduler_config: SchedulerConfiguration = field(
         default_factory=SchedulerConfiguration
     )
@@ -130,6 +136,27 @@ class Server:
         self._leader = False
         self._reaper: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
+        self.replicator = None  # set by setup_replication (multi-server)
+
+    # ------------------------------------------------------------------
+    # Consensus (server/replication.py)
+    # ------------------------------------------------------------------
+
+    def setup_replication(self, self_addr: str) -> None:
+        """Join the configured peer set: this server starts as a follower
+        and only runs leader services after winning an election.  Call
+        before :meth:`start` (the agent does, with its HTTP address)."""
+        from .replication import Replicator
+
+        self.replicator = Replicator(
+            self,
+            server_id=self.config.server_id or self_addr,
+            self_addr=self_addr,
+            peer_addrs=self.config.peers,
+            election_timeout=self.config.election_timeout,
+            heartbeat_interval=self.config.raft_heartbeat_interval,
+        )
+        self.store.replicator = self.replicator
 
     # ------------------------------------------------------------------
     # Log index — the Raft seam. Every mutation gets a unique, monotonic
@@ -146,6 +173,12 @@ class Server:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        if self.replicator is not None:
+            # Multi-server: everyone starts following; the election
+            # promotes exactly one (monitorLeadership, leader.go:54).
+            self.coalescer.start()
+            self.replicator.start()
+            return
         self.establish_leadership()
 
     def establish_leadership(self) -> None:
@@ -158,7 +191,7 @@ class Server:
         self.plan_queue.set_enabled(True)
         self.heartbeater.set_enabled(True)
         self.coalescer.start()
-        self.plan_applier.start()
+        self.plan_applier.start()  # idempotent: leadership can cycle
         for w in self.workers:
             w.start()
         self._restore_evals()
@@ -172,10 +205,11 @@ class Server:
         self.drainer.start()
         self.periodic.start()  # restores periodic jobs from state
         self._shutdown.clear()
-        self._reaper = threading.Thread(
-            target=self._run_reapers, name="leader-reapers", daemon=True
-        )
-        self._reaper.start()
+        if self._reaper is None or not self._reaper.is_alive():
+            self._reaper = threading.Thread(
+                target=self._run_reapers, name="leader-reapers", daemon=True
+            )
+            self._reaper.start()
 
     def revoke_leadership(self) -> None:
         if not self._leader:
@@ -192,6 +226,8 @@ class Server:
     def shutdown(self) -> None:
         self._shutdown.set()
         self._leader = False
+        if self.replicator is not None:
+            self.replicator.stop()
         self.deployment_watcher.stop()
         self.drainer.stop()
         self.periodic.stop()

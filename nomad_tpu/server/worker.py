@@ -42,6 +42,8 @@ class Worker:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # leadership can cycle; one thread per worker
         self._stop.clear()
         self._thread = threading.Thread(target=self.run, name="worker", daemon=True)
         self._thread.start()

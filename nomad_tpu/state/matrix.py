@@ -346,6 +346,20 @@ class NodeMatrix:
 
     # -- mutations ----------------------------------------------------------
 
+    def clear(self) -> None:
+        """Drop every row (snapshot install replaces all state). Registries
+        persist — attribute slots are append-only by design."""
+        with self._host_lock:
+            self.row_of.clear()
+            self.node_of.clear()
+            self._free.clear()
+            self._next_row = 0
+            self.class_ids.clear()
+            self.class_repr.clear()
+            self._alloc = self._allocate_arrays(self.capacity)
+            self._dirty.clear()
+            self._device_valid = False
+
     def upsert_node(self, node: Node) -> int:
         """Insert or refresh a node's static columns (totals, attrs, class).
 

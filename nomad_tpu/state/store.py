@@ -76,8 +76,9 @@ def journaled(fn):
     def wrapper(self, index, *args, **kwargs):
         with self._lock:
             if (
-                self.wal is None
+                (self.wal is None and self.replicator is None)
                 or self._replaying
+                or self._applying_remote
                 or self._journal_depth > 0
             ):
                 return fn(self, index, *args, **kwargs)
@@ -85,20 +86,36 @@ def journaled(fn):
                 kwargs["now"] = _time.time()
             from ..structs import serde
 
-            self.wal.append(
-                index,
-                op,
-                {
-                    "args": [serde.to_wire(a) for a in args],
-                    "kwargs": {k: serde.to_wire(v) for k, v in kwargs.items()},
-                },
-            )
+            args_wire = {
+                "args": [serde.to_wire(a) for a in args],
+                "kwargs": {k: serde.to_wire(v) for k, v in kwargs.items()},
+            }
+            if self.replicator is not None:
+                # Replicate FIRST: a write that cannot reach a quorum
+                # raises before anything lands locally (log or tables), so
+                # an uncommitted entry can never replay after a restart
+                # (raft's commit-then-apply order; replication.py).
+                seq_base = (
+                    self.wal.seq if self.wal is not None
+                    else self.replicator.last_seq
+                )
+                entry = {
+                    "i": index, "s": seq_base + 1, "op": op, "a": args_wire,
+                }
+                self.replicator.replicate(entry)
+                if self.wal is not None:
+                    self.wal.append_entry(entry)
+            else:
+                self.wal.append(index, op, args_wire)
             self._journal_depth += 1
             try:
                 out = fn(self, index, *args, **kwargs)
             finally:
                 self._journal_depth -= 1
-            if self.wal.appends_since_snapshot >= self.snapshot_every:
+            if (
+                self.wal is not None
+                and self.wal.appends_since_snapshot >= self.snapshot_every
+            ):
                 self.write_snapshot()
             return out
 
@@ -140,6 +157,11 @@ class StateStore:
         self._replaying = False
         self._journal_depth = 0
         self.snapshot_every = 4096
+        # Consensus seam (server/replication.py): when attached, journaled
+        # mutations replicate to peers before applying; _applying_remote
+        # marks follower-side applies of already-committed entries.
+        self.replicator = None
+        self._applying_remote = False
 
         # Change-event stream (nomad/stream/EventBroker): mutators publish
         # as they commit; restore replay does not re-publish history.
@@ -167,6 +189,14 @@ class StateStore:
         self._allocs_by_eval: Dict[str, Set[str]] = {}
         self._evals_by_job: Dict[Tuple[str, str], Set[str]] = {}
         self._deployments_by_job: Dict[Tuple[str, str], Set[str]] = {}
+
+        # MVCC version history: (table, key) -> recent replaced versions
+        # (newest last).  Snapshot reads resolve objects modified after
+        # their index back to the version visible at snapshot time — the
+        # memdb point-in-time discipline (state_store.go:171 Snapshot)
+        # with a bounded ring instead of immutable radix trees.
+        self._history: Dict[Tuple[str, object], List] = {}
+        self.history_depth = 4
 
     # ------------------------------------------------------------------
     # Index bookkeeping / blocking queries
@@ -204,6 +234,38 @@ class StateStore:
         with self._lock:
             return StateSnapshot(self, self.latest_index)
 
+    def _push_history(self, table: str, key, prev) -> None:
+        """Record a replaced/deleted version for MVCC snapshot reads.
+        Ring-bounded: a snapshot older than ``history_depth`` replacements
+        of one object degrades to the live read (documented staleness
+        bound; evals span ~100ms while objects churn far slower)."""
+        if prev is None:
+            return
+        ring = self._history.setdefault((table, key), [])
+        ring.append(prev)
+        if len(ring) > self.history_depth:
+            del ring[: len(ring) - self.history_depth]
+        # Amortized horizon GC: rings for long-dead keys (deleted objects
+        # never touched again) are dropped once far behind the log head.
+        if len(self._history) > 100_000:
+            horizon = self.latest_index - 10_000
+            self._history = {
+                k: r
+                for k, r in self._history.items()
+                if r and r[-1].modify_index >= horizon
+            }
+
+    def _resolve_at(self, table: str, key, live, snap_index: int):
+        """The version of (table, key) visible at ``snap_index``."""
+        if live is not None and live.modify_index <= snap_index:
+            return live
+        for old in reversed(self._history.get((table, key), ())):
+            if old.modify_index <= snap_index:
+                return old
+        if live is not None and live.create_index > snap_index:
+            return None  # created after the snapshot
+        return live  # history exhausted — bounded-staleness fallback
+
     def _publish(
         self, topic: str, type_: str, key: str, payload, index: int,
         namespace: str = "default",
@@ -230,6 +292,7 @@ class StateStore:
                 node.create_index = index
             else:
                 node.create_index = prev.create_index
+            self._push_history("nodes", node.id, prev)
             self.nodes[node.id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
@@ -238,7 +301,9 @@ class StateStore:
     @journaled
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
-            if self.nodes.pop(node_id, None) is not None:
+            prev = self.nodes.pop(node_id, None)
+            if prev is not None:
+                self._push_history("nodes", node_id, prev)
                 self.matrix.remove_node(node_id)
                 self._bump("nodes", index)
                 self._publish(
@@ -259,6 +324,7 @@ class StateStore:
             node.status = status
             node.modify_index = index
             node.status_updated_at = now if now is not None else _time.time()
+            self._push_history("nodes", node_id, prev)
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
@@ -277,6 +343,7 @@ class StateStore:
             node = _copy.copy(prev)
             node.scheduling_eligibility = eligibility
             node.modify_index = index
+            self._push_history("nodes", node_id, prev)
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
@@ -302,6 +369,7 @@ class StateStore:
             elif mark_eligible:
                 node.scheduling_eligibility = NodeSchedulingEligibility.ELIGIBLE.value
             node.modify_index = index
+            self._push_history("nodes", node_id, prev)
             self.nodes[node_id] = node
             self.matrix.upsert_node(node)
             self._bump("nodes", index)
@@ -338,6 +406,7 @@ class StateStore:
                     job.version = prev.version + 1
                 else:
                     job.version = prev.version
+            self._push_history("jobs", key, prev)
             self.jobs[key] = job
             versions = self.job_versions.setdefault(key, [])
             versions.append(job)
@@ -376,7 +445,9 @@ class StateStore:
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
             key = (namespace, job_id)
-            if self.jobs.pop(key, None) is not None:
+            prev = self.jobs.pop(key, None)
+            if prev is not None:
+                self._push_history("jobs", key, prev)
                 self.job_versions.pop(key, None)
                 self.job_summaries.pop(key, None)
                 self.periodic_launch.pop(key, None)
@@ -416,6 +487,7 @@ class StateStore:
                     ev.create_index = index
                 else:
                     ev.create_index = prev.create_index
+                self._push_history("evals", ev.id, prev)
                 self.evals[ev.id] = ev
                 self._evals_by_job.setdefault((ev.namespace, ev.job_id), set()).add(
                     ev.id
@@ -432,6 +504,7 @@ class StateStore:
         with self._lock:
             ev = self.evals.pop(eval_id, None)
             if ev is not None:
+                self._push_history("evals", eval_id, ev)
                 ids = self._evals_by_job.get((ev.namespace, ev.job_id))
                 if ids:
                     ids.discard(eval_id)
@@ -500,6 +573,7 @@ class StateStore:
 
                 if prev is not None:
                     self._unindex_alloc(prev)
+                    self._push_history("allocs", alloc.id, prev)
                 self.allocs[alloc.id] = alloc
                 self._index_alloc(alloc)
                 self._update_summary(alloc, prev, index)
@@ -516,6 +590,7 @@ class StateStore:
                         old2 = _copy.copy(old)
                         old2.next_allocation = alloc.id
                         old2.modify_index = index
+                        self._push_history("allocs", old2.id, old)
                         self.allocs[old2.id] = old2
             self._bump("allocs", index)
             for alloc in upserted:
@@ -552,6 +627,7 @@ class StateStore:
         with self._lock:
             alloc = self.allocs.pop(alloc_id, None)
             if alloc is not None:
+                self._push_history("allocs", alloc_id, alloc)
                 if not alloc.terminal_status():
                     self.matrix.remove_alloc(alloc)
                 self._unindex_alloc(alloc)
@@ -619,6 +695,7 @@ class StateStore:
                 deployment.create_index = index
             else:
                 deployment.create_index = prev.create_index
+            self._push_history("deployment", deployment.id, prev)
             self.deployments[deployment.id] = deployment
             self._deployments_by_job.setdefault(
                 (deployment.namespace, deployment.job_id), set()
@@ -634,6 +711,7 @@ class StateStore:
         with self._lock:
             d = self.deployments.pop(deployment_id, None)
             if d is not None:
+                self._push_history("deployment", deployment_id, d)
                 ids = self._deployments_by_job.get((d.namespace, d.job_id))
                 if ids:
                     ids.discard(deployment_id)
@@ -672,6 +750,7 @@ class StateStore:
             d2.status = status
             d2.status_description = description
             d2.modify_index = index
+            self._push_history("deployment", deployment_id, d)
             self.deployments[deployment_id] = d2
             self._bump("deployment", index)
             self._publish(
@@ -702,6 +781,7 @@ class StateStore:
                     st.promoted = True
             d2.status_description = "Deployment is running"
             d2.modify_index = index
+            self._push_history("deployment", deployment_id, d)
             self.deployments[deployment_id] = d2
             self._bump("deployment", index)
             self._publish(
@@ -763,6 +843,7 @@ class StateStore:
                 else st2.require_progress_by
             )
         d2.modify_index = index
+        self._push_history("deployment", d2.id, d)
         self.deployments[d2.id] = d2
         self._bump("deployment", index)
 
@@ -782,6 +863,7 @@ class StateStore:
                 a2 = _copy.copy(prev)
                 a2.desired_transition = transition
                 a2.modify_index = index
+                self._push_history("allocs", alloc_id, prev)
                 self.allocs[alloc_id] = a2
             self._bump("allocs", index)
 
@@ -852,6 +934,64 @@ class StateStore:
         with self._lock:
             self.wal = wal
             self.snapshot_every = snapshot_every
+
+    # ------------------------------------------------------------------
+    # Replication seam (server/replication.py)
+    # ------------------------------------------------------------------
+
+    def apply_remote(self, entry: dict) -> None:
+        """Apply one committed entry from the leader's stream (follower
+        side): journal it locally (same seq), then run the mutator with
+        leader-side replication suppressed."""
+        from ..structs import serde
+
+        with self._lock:
+            if self.wal is not None:
+                self.wal.append_entry(entry)
+            args = [serde.from_wire(a) for a in entry["a"]["args"]]
+            kwargs = {
+                k: serde.from_wire(v)
+                for k, v in entry["a"]["kwargs"].items()
+            }
+            self._applying_remote = True
+            try:
+                getattr(self, entry["op"])(entry["i"], *args, **kwargs)
+            finally:
+                self._applying_remote = False
+            if (
+                self.wal is not None
+                and self.wal.appends_since_snapshot >= self.snapshot_every
+            ):
+                self.write_snapshot()
+
+    def install_snapshot(self, snapshot_wire: dict, seq: int) -> None:
+        """Replace ALL local state with the leader's FSM image (raft
+        InstallSnapshot): reset tables + matrix, restore, persist."""
+        with self._lock:
+            self._reset_tables_locked()
+            self.restore(snapshot_wire, [])
+            if self.wal is not None:
+                self.wal.seq = seq
+                self.wal.write_snapshot(self.to_snapshot_wire())
+
+    def _reset_tables_locked(self) -> None:
+        self.matrix.clear()
+        self.latest_index = 0
+        self._table_index.clear()
+        self.nodes.clear()
+        self.jobs.clear()
+        self.job_versions.clear()
+        self.evals.clear()
+        self.allocs.clear()
+        self.deployments.clear()
+        self.job_summaries.clear()
+        self.periodic_launch.clear()
+        self._allocs_by_node.clear()
+        self._allocs_by_job.clear()
+        self._allocs_by_eval.clear()
+        self._evals_by_job.clear()
+        self._deployments_by_job.clear()
+        self._history.clear()
 
     def to_snapshot_wire(self) -> dict:
         """Serialize the full FSM image (matrix excluded — it is rebuilt by
@@ -947,43 +1087,84 @@ class StateStore:
 
 
 class StateSnapshot:
-    """A scheduler-facing read view pinned at ``snapshot_index``.
+    """A scheduler-facing point-in-time read view at ``snapshot_index``.
 
     Implements the scheduler ``State`` interface (scheduler/scheduler.go:65).
-    Reads delegate to the live store (see module docstring for why that is
-    sound in this architecture).
+    Objects modified after the snapshot resolve back through the store's
+    MVCC history ring to the version visible at snapshot time; objects
+    created after it are invisible — the memdb point-in-time discipline
+    (state_store.go:171 Snapshot / :198 SnapshotMinIndex).  Bound: a
+    snapshot older than ``history_depth`` replacements of one object
+    degrades to the live version (the applier's serialized re-verify still
+    protects commits — plan_apply.go:49-69).  GC deletions (terminal
+    objects reaped after the snapshot) simply vanish from index scans;
+    they were terminal in both views.
     """
 
     def __init__(self, store: StateStore, index: int):
         self.store = store
         self.snapshot_index = index
+        # Runtime config is an immutable-replace singleton: pin it now.
+        self._scheduler_config = store.scheduler_config
+
+    def _at(self, table: str, key, live):
+        return self.store._resolve_at(table, key, live, self.snapshot_index)
 
     def ready_nodes_in_dcs(self, datacenters) -> List[Node]:
-        return self.store.ready_nodes_in_dcs(datacenters)
+        dcs = set(datacenters)
+        return [
+            n for n in self.nodes()
+            if n.ready() and (not dcs or n.datacenter in dcs)
+        ]
 
     def nodes(self) -> List[Node]:
-        return list(self.store.nodes.values())
+        store = self.store
+        with store._lock:
+            out = [
+                self._at("nodes", nid, n) for nid, n in store.nodes.items()
+            ]
+        return [n for n in out if n is not None]
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
-        return self.store.node_by_id(node_id)
+        return self._at("nodes", node_id, self.store.nodes.get(node_id))
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
-        return self.store.job_by_id(namespace, job_id)
+        key = (namespace, job_id)
+        return self._at("jobs", key, self.store.jobs.get(key))
 
     def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
-        return self.store.allocs_by_job(namespace, job_id)
+        store = self.store
+        with store._lock:
+            ids = list(store._allocs_by_job.get((namespace, job_id), ()))
+            out = [self._at("allocs", i, store.allocs.get(i)) for i in ids]
+        return [a for a in out if a is not None]
 
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
-        return self.store.allocs_by_node(node_id)
+        store = self.store
+        with store._lock:
+            ids = list(store._allocs_by_node.get(node_id, ()))
+            out = [self._at("allocs", i, store.allocs.get(i)) for i in ids]
+        return [a for a in out if a is not None]
 
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
-        return self.store.eval_by_id(eval_id)
+        return self._at("evals", eval_id, self.store.evals.get(eval_id))
 
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
-        return self.store.deployment_by_id(deployment_id)
+        return self._at(
+            "deployment", deployment_id,
+            self.store.deployments.get(deployment_id),
+        )
 
     def latest_deployment_by_job(self, namespace: str, job_id: str):
-        return self.store.latest_deployment_by_job(namespace, job_id)
+        store = self.store
+        with store._lock:
+            ids = list(store._deployments_by_job.get((namespace, job_id), ()))
+            best: Optional[Deployment] = None
+            for i in ids:
+                d = self._at("deployment", i, store.deployments.get(i))
+                if d and (best is None or d.create_index > best.create_index):
+                    best = d
+        return best
 
     def scheduler_config(self) -> SchedulerConfiguration:
-        return self.store.scheduler_config
+        return self._scheduler_config

@@ -117,13 +117,21 @@ class WriteAheadLog:
             self._fh = open(self.log_path, "a", encoding="utf-8")
         return self._fh
 
-    def append(self, index: int, op: str, args_wire: Any) -> None:
-        fh = self._open()
+    def append(self, index: int, op: str, args_wire: Any) -> dict:
         self.seq += 1
-        fh.write(
-            json.dumps({"i": index, "s": self.seq, "op": op, "a": args_wire})
-            + "\n"
-        )
+        entry = {"i": index, "s": self.seq, "op": op, "a": args_wire}
+        self._write(entry)
+        return entry
+
+    def append_entry(self, entry: dict) -> None:
+        """Append a replicated entry verbatim (follower path): the leader
+        assigned its sequence; ours must mirror it."""
+        self._write(entry)
+        self.seq = entry["s"]
+
+    def _write(self, entry: dict) -> None:
+        fh = self._open()
+        fh.write(json.dumps(entry) + "\n")
         fh.flush()
         if self.fsync:
             os.fsync(fh.fileno())
