@@ -25,6 +25,8 @@ class AgentConfig:
     # Remote server agent address for client-only agents (the wire seam:
     # client/client.go dials servers; here HTTP at /v1/internal/*).
     server_addr: str = ""
+    # Node ACL secret attached to every server RPC (client acl.token).
+    client_token: str = ""
     http_host: str = "127.0.0.1"
     http_port: int = 0  # 0 = ephemeral
     server_config: ServerConfig = field(default_factory=ServerConfig)
@@ -51,8 +53,11 @@ class Agent:
                     if a.strip()
                 ]
                 server_handle = (
-                    FailoverRPC(addrs) if len(addrs) > 1
-                    else HTTPServerRPC(addrs[0])
+                    FailoverRPC(addrs, token=self.config.client_token)
+                    if len(addrs) > 1
+                    else HTTPServerRPC(
+                        addrs[0], token=self.config.client_token
+                    )
                 )
             else:
                 raise ValueError(
@@ -77,6 +82,13 @@ class Agent:
         if self.server is not None:
             self.server.start()
         if self.client is not None:
+            # Advertise this agent's HTTP address on the node so servers
+            # can forward task-fs/log requests to it (the reference
+            # advertises client HTTP addrs the same way).
+            self.client.node.attributes = dict(self.client.node.attributes)
+            self.client.node.attributes["nomad.advertise.address"] = (
+                self.rpc_addr
+            )
             self.client.start()
         self.http.start()
 

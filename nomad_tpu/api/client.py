@@ -18,16 +18,20 @@ class APIError(Exception):
 
 
 class APIClient:
-    def __init__(self, address: str = "http://127.0.0.1:4646"):
+    def __init__(self, address: str = "http://127.0.0.1:4646", token: str = ""):
         self.address = address.rstrip("/")
+        self.token = token  # X-Nomad-Token (SecretID) on every request
 
     def _call(
         self, method: str, path: str, body: Optional[Any] = None
     ) -> Any:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
         req = urllib.request.Request(
             f"{self.address}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
@@ -44,8 +48,42 @@ class APIClient:
     def register_job(self, job_payload: Dict) -> Dict:
         return self._call("PUT", "/v1/jobs", {"Job": job_payload})
 
+    def plan_job(
+        self, job_id: str, job_payload: Dict, diff: bool = False,
+        namespace: str = "default",
+    ) -> Dict:
+        return self._call(
+            "PUT",
+            f"/v1/job/{job_id}/plan?namespace={namespace}",
+            {"Job": job_payload, "Diff": diff},
+        )
+
     def list_jobs(self, prefix: str = "") -> List[Dict]:
         return self._call("GET", f"/v1/jobs?prefix={prefix}")
+
+    # ACL --------------------------------------------------------------
+
+    def acl_bootstrap(self) -> Dict:
+        return self._call("POST", "/v1/acl/bootstrap")
+
+    def acl_upsert_policy(
+        self, name: str, rules: str, description: str = ""
+    ) -> Dict:
+        return self._call(
+            "PUT", f"/v1/acl/policy/{name}",
+            {"Rules": rules, "Description": description},
+        )
+
+    def acl_create_token(
+        self, name: str = "", type: str = "client",
+        policies: Optional[List[str]] = None,
+    ) -> Dict:
+        return self._call("POST", "/v1/acl/token", {
+            "Name": name, "Type": type, "Policies": policies or [],
+        })
+
+    def acl_token_self(self) -> Dict:
+        return self._call("GET", "/v1/acl/token/self")
 
     def get_job(self, job_id: str, namespace: str = "default") -> Dict:
         return self._call("GET", f"/v1/job/{job_id}?namespace={namespace}")

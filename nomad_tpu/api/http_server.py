@@ -11,6 +11,7 @@ import dataclasses
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -69,12 +70,31 @@ class HTTPAPIServer:
                     query = {k: v[0] for k, v in multi.items()}
                     if parsed.path == "/v1/event/stream" and method == "GET":
                         # NDJSON stream — bypasses the one-shot JSON path.
-                        api.stream_events(self, multi)
+                        stream_token = self.headers.get(
+                            "X-Nomad-Token", query.get("token", "")
+                        )
+                        api.stream_events(self, multi, token=stream_token)
+                        return
+                    if parsed.path.startswith("/v1/client/fs/") and (
+                        method == "GET"
+                    ):
+                        # Raw-byte (possibly streaming) task-fs surface.
+                        fs_token = self.headers.get(
+                            "X-Nomad-Token", query.get("token", "")
+                        )
+                        api.serve_client_fs(
+                            self, parsed.path, query, token=fs_token
+                        )
                         return
                     length = int(self.headers.get("Content-Length", 0) or 0)
                     raw = self.rfile.read(length) if length else b""
                     body = json.loads(raw) if raw else None
-                    result = api.route(method, parsed.path, query, body)
+                    token = self.headers.get(
+                        "X-Nomad-Token", query.get("token", "")
+                    )
+                    result = api.route(
+                        method, parsed.path, query, body, token=token
+                    )
                     self._respond(200, result)
                 except HTTPError as exc:
                     self._respond(exc.code, {"error": exc.message})
@@ -121,10 +141,14 @@ class HTTPAPIServer:
     # command/agent/event_endpoint.go)
     # ------------------------------------------------------------------
 
-    def stream_events(self, handler, multi_query: Dict) -> None:
+    def stream_events(self, handler, multi_query: Dict, token: str = "") -> None:
         server = self.agent.server
         if server is None:
             raise HTTPError(501, "agent is not running a server")
+        if server.config.acl_enabled:
+            acl = server.resolve_token(token)
+            if acl is None or not acl.allow_agent("read"):
+                raise HTTPError(403, "Permission denied (agent:read)")
         # topic filters: repeated topic=Topic:key params ("*" wildcards).
         topics: Dict[str, list] = {}
         for spec in multi_query.get("topic", ["*:*"]):
@@ -159,10 +183,312 @@ class HTTPAPIServer:
             sub.close()
 
     # ------------------------------------------------------------------
+    # ACL enforcement (reference: per-endpoint ResolveToken + capability
+    # checks across nomad/*_endpoint.go; trimmed to a route→capability
+    # map here)
+    # ------------------------------------------------------------------
+
+    def _check_acl(
+        self, server, method: str, path: str, query: Dict, token: str
+    ) -> None:
+        from ..acl import CAP_READ_JOB, CAP_SUBMIT_JOB
+
+        acl = server.resolve_token(token)
+        if acl is None:
+            raise HTTPError(403, "ACL token not found")
+        read = method == "GET"
+        if path == "/v1/jobs/parse":
+            return  # pure function of its input
+        if path.startswith("/v1/acl"):
+            if path == "/v1/acl/token/self":
+                return  # any valid token may read itself
+            if not acl.management:
+                raise HTTPError(403, "Permission denied (management only)")
+            return
+        if path.startswith("/v1/internal/node") or path == "/v1/nodes" or (
+            path.startswith("/v1/node")
+        ):
+            want = "read" if read else "write"
+            if not acl.allow_node(want):
+                raise HTTPError(403, f"Permission denied (node:{want})")
+            return
+        if path.startswith("/v1/operator") or path.startswith("/v1/system"):
+            want = "read" if read else "write"
+            if not acl.allow_operator(want):
+                raise HTTPError(403, f"Permission denied (operator:{want})")
+            return
+        if path == "/v1/jobs" or path.startswith("/v1/job"):
+            ns = query.get("namespace", "default")
+            cap = CAP_READ_JOB if read else CAP_SUBMIT_JOB
+            if not acl.allow_namespace(ns, cap):
+                raise HTTPError(403, f"Permission denied ({cap})")
+            return
+        if path.startswith("/v1/allocation") or path.startswith(
+            "/v1/evaluation"
+        ) or path == "/v1/deployments" or path.startswith("/v1/deployment"):
+            ns = query.get("namespace", "default")
+            if not acl.allow_namespace(ns, CAP_READ_JOB):
+                raise HTTPError(403, "Permission denied (read-job)")
+            return
+        # Agent-level surface (members, metrics, event stream).
+        want = "read" if read else "write"
+        if not acl.allow_agent(want):
+            raise HTTPError(403, f"Permission denied (agent:{want})")
+
+    def _route_acl(
+        self, server, method: str, path: str, query: Dict, body: Any,
+        token: str,
+    ) -> Any:
+        from ..structs import serde
+        from ..structs.types import ACLPolicy, ACLToken
+
+        if path == "/v1/acl/bootstrap" and method in ("PUT", "POST"):
+            try:
+                t = server.bootstrap_acl()
+            except PermissionError as exc:
+                raise HTTPError(400, str(exc))
+            return _dump(t)
+        if path == "/v1/acl/policies" and method == "GET":
+            return [
+                {"Name": p.name, "Description": p.description}
+                for p in server.store.acl_policies.values()
+            ]
+        m = re.match(r"^/v1/acl/policy/([^/]+)$", path)
+        if m:
+            if method == "GET":
+                p = server.store.acl_policies.get(m.group(1))
+                if p is None:
+                    raise HTTPError(404, "policy not found")
+                return _dump(p)
+            if method in ("PUT", "POST"):
+                from ..acl import parse_policy
+
+                rules = (body or {}).get("Rules", "")
+                parse_policy(rules)  # validate before committing
+                server.store.upsert_acl_policy(
+                    server.next_index(),
+                    ACLPolicy(
+                        name=m.group(1),
+                        description=(body or {}).get("Description", ""),
+                        rules=rules,
+                    ),
+                )
+                return {}
+            if method == "DELETE":
+                server.store.delete_acl_policy(
+                    server.next_index(), m.group(1)
+                )
+                return {}
+        if path == "/v1/acl/tokens" and method == "GET":
+            return [
+                _dump(t, exclude=("secret_id",))
+                for t in server.store.acl_tokens.values()
+            ]
+        if path == "/v1/acl/token" and method in ("PUT", "POST"):
+            t = ACLToken(
+                name=(body or {}).get("Name", ""),
+                type=(body or {}).get("Type", "client"),
+                policies=list((body or {}).get("Policies", [])),
+                create_time=time.time(),
+            )
+            server.store.upsert_acl_tokens(server.next_index(), [t])
+            return _dump(t)
+        m = re.match(r"^/v1/acl/token/([^/]+)$", path)
+        if m and method == "DELETE":
+            server.store.delete_acl_token(server.next_index(), m.group(1))
+            return {}
+        if path == "/v1/acl/token/self" and method == "GET":
+            t = server.store.acl_token_by_secret(token)
+            if t is None:
+                raise HTTPError(404, "token not found")
+            return _dump(t)
+        raise HTTPError(404, f"unknown ACL route {path}")
+
+    # ------------------------------------------------------------------
+    # Task filesystem + logs (reference: command/agent/fs_endpoint.go
+    # /v1/client/fs/* — served by the agent holding the alloc, forwarded
+    # by servers to the node's advertised agent address; the reference
+    # forwards over the reverse yamux session, nomad/client_rpc.go)
+    # ------------------------------------------------------------------
+
+    def serve_client_fs(
+        self, handler, path: str, query: Dict, token: str = ""
+    ) -> None:
+        from ..acl import CAP_READ_FS, CAP_READ_LOGS
+
+        cap = CAP_READ_LOGS if "/logs/" in path else CAP_READ_FS
+        ns = query.get("namespace", "default")
+        server = self.agent.server
+        if server is not None:
+            if server.config.acl_enabled:
+                acl = server.resolve_token(token)
+                if acl is None or not acl.allow_namespace(ns, cap):
+                    raise HTTPError(403, f"Permission denied ({cap})")
+        elif self.agent.client is not None:
+            # Client-only agent: it cannot resolve tokens itself — forward
+            # the capability check to its server (the reference's clients
+            # resolve ACLs via server RPC too). Reaching the node agent
+            # directly must not bypass the ACLs the server enforces.
+            try:
+                allowed = self.agent.client.server.check_acl_capability(
+                    token, "namespace", cap, ns
+                )
+            except Exception as exc:  # noqa: BLE001 — fail closed
+                raise HTTPError(502, f"ACL check unavailable: {exc}")
+            if not allowed:
+                raise HTTPError(403, f"Permission denied ({cap})")
+
+        m = re.match(r"^/v1/client/fs/(ls|cat|logs)/([^/?]+)$", path)
+        if not m:
+            raise HTTPError(404, f"unknown fs route {path}")
+        op, alloc_id = m.group(1), m.group(2)
+
+        client = self.agent.client
+        if client is None or alloc_id not in client.allocs:
+            self._forward_client_fs(handler, path, query, alloc_id, token)
+            return
+
+        from ..client.client import AllocFSError
+
+        try:
+            if op == "ls":
+                body = json.dumps(
+                    client.list_files(alloc_id, query.get("path", ""))
+                ).encode()
+                self._raw_respond(handler, 200, body, "application/json")
+                return
+            if op == "cat":
+                data = client.read_file(
+                    alloc_id,
+                    query.get("path", ""),
+                    offset=int(query.get("offset", "0")),
+                    limit=int(query.get("limit", str(1 << 20))),
+                )
+                self._raw_respond(
+                    handler, 200, data, "application/octet-stream"
+                )
+                return
+            # logs: tail + optional follow stream.  Positions are tracked
+            # absolutely so bytes appended between the initial read and
+            # the follow loop are never dropped.
+            import os as _os
+
+            rel = client.task_log_path(
+                query.get("task", ""), query.get("type", "stdout")
+            )
+            offset = int(query.get("offset", "-65536"))
+            follow = query.get("follow", "") in ("true", "1")
+            target = client._resolve_fs_path(alloc_id, rel)
+            size = _os.path.getsize(target)
+            pos = max(0, size + offset) if offset < 0 else min(offset, size)
+            data = client.read_file(
+                alloc_id, rel, offset=pos, limit=max(0, size - pos)
+            )
+            pos += len(data)
+        except AllocFSError as exc:
+            raise HTTPError(exc.code, str(exc))
+        except OSError as exc:
+            raise HTTPError(404, str(exc))
+
+        if not follow:
+            self._raw_respond(handler, 200, data, "text/plain")
+            return
+        # Follow mode: chunked growth polling until the reader hangs up
+        # (the reference's StreamFile frames; plain byte chunks here).
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        try:
+            handler.wfile.write(data)
+            handler.wfile.flush()
+            while True:
+                size = _os.path.getsize(target)
+                if size > pos:
+                    chunk = client.read_file(
+                        alloc_id, rel, offset=pos, limit=size - pos
+                    )
+                    handler.wfile.write(chunk)
+                    handler.wfile.flush()
+                    pos += len(chunk)
+                time.sleep(0.25)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # reader went away / alloc dir removed
+        except Exception:  # noqa: BLE001 — alloc GC'd mid-follow
+            pass
+
+    def _forward_client_fs(
+        self, handler, path: str, query: Dict, alloc_id: str, token: str
+    ) -> None:
+        """Server-side forwarding: stream the node agent's response
+        through (fs_endpoint.go forwarding leg)."""
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        server = self.agent.server
+        if server is None:
+            raise HTTPError(404, f"allocation {alloc_id} not on this agent")
+        alloc = server.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise HTTPError(404, f"unknown allocation {alloc_id}")
+        from ..state.matrix import node_attributes
+
+        node = server.store.node_by_id(alloc.node_id)
+        addr = (
+            node_attributes(node).get("nomad.advertise.address", "")
+            if node is not None else ""
+        )
+        if not addr or addr == self.addr:
+            raise HTTPError(
+                404, f"allocation {alloc_id} has no reachable node agent"
+            )
+        qs = urllib.parse.urlencode(query)
+        req = urllib.request.Request(
+            f"{addr}{path}?{qs}",
+            headers={"X-Nomad-Token": token} if token else {},
+        )
+        try:
+            # Generous timeout: follow-mode streams are idle between chunks.
+            upstream = urllib.request.urlopen(req, timeout=300)
+        except urllib.error.HTTPError as exc:
+            raise HTTPError(exc.code, exc.read().decode(errors="replace"))
+        with upstream:
+            handler.send_response(upstream.status)
+            handler.send_header(
+                "Content-Type",
+                upstream.headers.get("Content-Type", "text/plain"),
+            )
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            try:
+                while True:
+                    # read1: pass chunks through as they arrive (read(n)
+                    # would stall a live follow stream until n bytes).
+                    chunk = upstream.read1(65536)
+                    if not chunk:
+                        break
+                    handler.wfile.write(chunk)
+                    handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+
+    @staticmethod
+    def _raw_respond(handler, code: int, body: bytes, ctype: str) -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    # ------------------------------------------------------------------
     # Routing (http.go:252-324)
     # ------------------------------------------------------------------
 
-    def route(self, method: str, path: str, query: Dict, body: Any) -> Any:
+    def route(
+        self, method: str, path: str, query: Dict, body: Any,
+        token: str = "",
+    ) -> Any:
         server = self.agent.server
         if server is None:
             raise HTTPError(501, "agent is not running a server")
@@ -186,6 +512,15 @@ class HTTPAPIServer:
         # ---- leader gate: writes (and node RPCs) only serve on the leader
         # (the reference forwards to the leader, nomad/rpc.go forward; we
         # redirect — FailoverRPC/CLI follow the hint) ----
+        # Any server can answer capability checks (ACL tables replicate).
+        if path == "/v1/internal/acl/check":
+            return {"Allowed": server.check_acl_capability(
+                (body or {}).get("Token", ""),
+                (body or {}).get("Kind", "agent"),
+                (body or {}).get("Capability", "read"),
+                (body or {}).get("Namespace", "default"),
+            )}
+
         rep = store.replicator
         if rep is not None and not rep.is_leader:
             is_write = method in ("PUT", "POST", "DELETE") and path not in (
@@ -195,6 +530,15 @@ class HTTPAPIServer:
                 raise HTTPError(
                     409, f"not leader; leader={rep.leader_addr}"
                 )
+
+        # ---- ACL enforcement (nomad/acl.go resolution + per-endpoint
+        # capability checks; anonymous policy when no token) ----
+        if server.config.acl_enabled and path != "/v1/acl/bootstrap":
+            self._check_acl(server, method, path, query, token)
+
+        # ---- ACL endpoints (nomad/acl_endpoint.go) ----
+        if path.startswith("/v1/acl"):
+            return self._route_acl(server, method, path, query, body, token)
 
         # ---- internal node RPCs (client↔server wire; api/rpc.py peer) ----
         if path.startswith("/v1/internal/"):
@@ -260,6 +604,17 @@ class HTTPAPIServer:
                 if ev is None:
                     raise HTTPError(404, "job not found")
                 return {"EvalID": ev.id}
+        m = re.match(r"^/v1/job/([^/]+)/plan$", path)
+        if m and method in ("PUT", "POST"):
+            payload = (body or {}).get("Job", body)
+            if payload is None:
+                raise HTTPError(400, "missing job")
+            job = api_to_job(payload)
+            if job.id != m.group(1):
+                raise HTTPError(400, "job id does not match URL")
+            return server.plan_job(
+                job, diff=bool((body or {}).get("Diff", False))
+            )
         m = re.match(r"^/v1/job/([^/]+)/allocations$", path)
         if m and method == "GET":
             ns = query.get("namespace", "default")

@@ -34,21 +34,30 @@ class RPCError(Exception):
 
 
 class HTTPServerRPC:
-    """The client's handle to a remote server agent."""
+    """The client's handle to a remote server agent.
 
-    def __init__(self, addr: str, timeout: float = 10.0):
+    ``token`` is the node's ACL secret (the reference's client
+    ``acl.token`` config), attached to every RPC so ACL-enabled servers
+    authorize the node endpoints.
+    """
+
+    def __init__(self, addr: str, timeout: float = 10.0, token: str = ""):
         self.addr = addr.rstrip("/")
         self.timeout = timeout
+        self.token = token
 
     # ------------------------------------------------------------------
 
     def _call(self, path: str, payload=None, timeout=None):
         data = json.dumps(payload).encode() if payload is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
         req = urllib.request.Request(
             self.addr + path,
             data=data,
             method="POST" if data is not None else "GET",
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(
@@ -102,6 +111,16 @@ class HTTPServerRPC:
             {"Allocs": [serde.to_wire(a) for a in updates]},
         )
 
+    def check_acl_capability(
+        self, token: str, kind: str, capability: str,
+        namespace: str = "default",
+    ) -> bool:
+        out = self._call("/v1/internal/acl/check", {
+            "Token": token, "Kind": kind, "Capability": capability,
+            "Namespace": namespace,
+        })
+        return bool(out.get("Allowed"))
+
 
 # The hint travels inside a JSON error body — stop before quote/brace.
 _LEADER_HINT = re.compile(r"leader=([^\s\"'}]+)")
@@ -118,9 +137,11 @@ class FailoverRPC:
     client/servers/manager.go).
     """
 
-    def __init__(self, addrs: List[str], timeout: float = 10.0):
+    def __init__(self, addrs: List[str], timeout: float = 10.0, token: str = ""):
         assert addrs, "need at least one server address"
-        self.rpcs = {a: HTTPServerRPC(a, timeout=timeout) for a in addrs}
+        self.rpcs = {
+            a: HTTPServerRPC(a, timeout=timeout, token=token) for a in addrs
+        }
         self.addrs = list(addrs)
         self.current = self.addrs[0]
 
@@ -163,3 +184,6 @@ class FailoverRPC:
 
     def update_allocs_from_client(self, updates: List[Allocation]) -> None:
         return self._with_failover("update_allocs_from_client", updates)
+
+    def check_acl_capability(self, *args, **kwargs) -> bool:
+        return self._with_failover("check_acl_capability", *args, **kwargs)

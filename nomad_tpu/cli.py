@@ -20,7 +20,7 @@ DEFAULT_ADDR = os.environ.get("NOMAD_TPU_ADDR", "http://127.0.0.1:4646")
 
 
 def _client(args) -> APIClient:
-    return APIClient(args.address)
+    return APIClient(args.address, token=getattr(args, "token", ""))
 
 
 def _print(obj) -> None:
@@ -88,6 +88,37 @@ def cmd_job_run(args) -> int:
         time.sleep(0.2)
     print("timed out waiting for evaluation")
     return 1
+
+
+def cmd_job_plan(args) -> int:
+    """Dry-run the scheduler on a jobspec: what WOULD change
+    (reference: `nomad job plan`, command/job_plan.go)."""
+    job = parse_job(open(args.jobfile).read())
+    client = _client(args)
+    result = client.plan_job(
+        job.id, job_to_api(job), diff=args.diff, namespace=job.namespace
+    )
+    diff = result.get("Diff")
+    if diff:
+        fields = f" ({', '.join(diff['Fields'])})" if diff["Fields"] else ""
+        print(f"Job: {diff['Type']}{fields}")
+    for tg, counts in (
+        result.get("Annotations", {}).get("DesiredTGUpdates", {}) or {}
+    ).items():
+        shown = {k: v for k, v in counts.items() if v}
+        print(f"Task Group {tg!r}: {shown or 'no changes'}")
+    failed = result.get("FailedTGAllocs") or {}
+    for tg, metric in failed.items():
+        print(
+            f"WARNING: task group {tg!r} would have "
+            f"{metric.get('coalesced_failures', 0) + 1} unplaced alloc(s)"
+        )
+    print(
+        "\nJob Modify Index:", result.get("JobModifyIndex", 0),
+        "\n(run with this index via -check-index semantics to guard "
+        "against concurrent changes)",
+    )
+    return 1 if failed else 0
 
 
 def cmd_job_status(args) -> int:
@@ -200,6 +231,75 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    """Tail (optionally follow) a task's stdout/stderr
+    (reference: `nomad alloc logs`, command/alloc_logs.go)."""
+    import urllib.parse
+    import urllib.request
+
+    task = args.task
+    if not task:
+        alloc = _client(args).get_allocation(args.alloc_id)
+        states = alloc.get("task_states") or {}
+        task = next(iter(states), "main")
+    qs = urllib.parse.urlencode({
+        "task": task,
+        "type": "stderr" if args.stderr else "stdout",
+        "follow": "true" if args.follow else "false",
+        "offset": str(-args.tail_bytes),
+    })
+    url = f"{args.address}/v1/client/fs/logs/{args.alloc_id}?{qs}"
+    req = urllib.request.Request(url)
+    if getattr(args, "token", ""):
+        req.add_header("X-Nomad-Token", args.token)
+    try:
+        with urllib.request.urlopen(req, timeout=None) as resp:
+            while True:
+                # read1 returns available bytes — read(n) would block a
+                # live follow stream until n accumulate.
+                chunk = resp.read1(8192)
+                if not chunk:
+                    break
+                sys.stdout.write(chunk.decode(errors="replace"))
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_alloc_fs(args) -> int:
+    """List or read files in an allocation's directory
+    (reference: `nomad alloc fs`, command/alloc_fs.go)."""
+    import urllib.parse
+    import urllib.request
+
+    qs = urllib.parse.urlencode({"path": args.path})
+    base = f"{args.address}/v1/client/fs"
+    # ls first; fall back to cat when the path is a file.
+    for op in ("ls", "cat"):
+        req = urllib.request.Request(
+            f"{base}/{op}/{args.alloc_id}?{qs}"
+        )
+        if getattr(args, "token", ""):
+            req.add_header("X-Nomad-Token", args.token)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as exc:
+            if op == "ls" and exc.code == 404:
+                continue
+            print(exc.read().decode(errors="replace"), file=sys.stderr)
+            return 1
+        if op == "ls":
+            for entry in json.loads(body):
+                kind = "d" if entry["IsDir"] else "-"
+                print(f"{kind} {entry['Size']:>10} {entry['Name']}")
+        else:
+            sys.stdout.write(body.decode(errors="replace"))
+        return 0
+    return 1
+
+
 def cmd_eval_status(args) -> int:
     client = _client(args)
     _print(client.get_evaluation(args.eval_id))
@@ -231,6 +331,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="nomad-tpu", description="TPU-native workload orchestrator"
     )
     p.add_argument("--address", default=DEFAULT_ADDR)
+    p.add_argument("--token", default=os.environ.get("NOMAD_TOKEN", ""),
+                   help="ACL secret (or NOMAD_TOKEN)")
     sub = p.add_subparsers(dest="command", required=True)
 
     agent = sub.add_parser("agent", help="run an agent (server+client)")
@@ -254,6 +356,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("jobfile")
     run.add_argument("-detach", action="store_true")
     run.set_defaults(fn=cmd_job_run)
+    plan = job.add_parser("plan")
+    plan.add_argument("jobfile")
+    plan.add_argument("-diff", action="store_true", default=False)
+    plan.set_defaults(fn=cmd_job_plan)
+
     status = job.add_parser("status")
     status.add_argument("job_id", nargs="?")
     status.add_argument("--namespace", default="default")
@@ -291,6 +398,20 @@ def build_parser() -> argparse.ArgumentParser:
     astatus.add_argument("alloc_id")
     astatus.add_argument("-verbose", action="store_true")
     astatus.set_defaults(fn=cmd_alloc_status)
+
+    alogs = alloc.add_parser("logs")
+    alogs.add_argument("alloc_id")
+    alogs.add_argument("task", nargs="?", default="")
+    alogs.add_argument("-f", "--follow", action="store_true", dest="follow")
+    alogs.add_argument("-stderr", action="store_true", dest="stderr")
+    alogs.add_argument("-tail-bytes", type=int, default=65536,
+                       dest="tail_bytes")
+    alogs.set_defaults(fn=cmd_alloc_logs)
+
+    afs = alloc.add_parser("fs")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?", default="")
+    afs.set_defaults(fn=cmd_alloc_fs)
 
     ev = sub.add_parser("eval", help="evaluation ops").add_subparsers(
         dest="eval_cmd", required=True

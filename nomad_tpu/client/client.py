@@ -37,6 +37,15 @@ log = logging.getLogger(__name__)
 UPDATE_BATCH_WINDOW = 0.2
 
 
+class AllocFSError(Exception):
+    """Task-filesystem access failure, carrying the HTTP status the API
+    layer should surface (fs_endpoint.go error mapping)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
 @dataclass
 class ClientConfig:
     datacenter: str = "dc1"
@@ -134,6 +143,7 @@ class Client:
             self._dirty_cond.notify_all()
         for ar in list(self.allocs.values()):
             ar.destroy()
+        self.drivers.shutdown()
 
     def _restore_allocs(self) -> None:
         """Recover persisted allocs: re-attach or fail their tasks
@@ -299,3 +309,74 @@ class Client:
     def num_allocs(self) -> int:
         with self._lock:
             return len(self.allocs)
+
+    # ------------------------------------------------------------------
+    # Task filesystem access (reference: client FileSystem RPCs served
+    # over the reverse session, nomad/client_rpc.go +
+    # command/agent/fs_endpoint.go; logs stream from the task dirs the
+    # drivers write into)
+    # ------------------------------------------------------------------
+
+    def _alloc_fs_dir(self, alloc_id: str) -> str:
+        with self._lock:
+            ar = self.allocs.get(alloc_id)
+        if ar is None:
+            raise AllocFSError(404, f"unknown allocation {alloc_id}")
+        return ar.alloc_dir
+
+    def _resolve_fs_path(self, alloc_id: str, rel_path: str) -> str:
+        """Path inside the alloc dir; rejects escapes (fs_endpoint.go
+        sandboxing)."""
+        import os
+
+        base = os.path.realpath(self._alloc_fs_dir(alloc_id))
+        target = os.path.realpath(os.path.join(base, rel_path or "."))
+        if target != base and not target.startswith(base + os.sep):
+            raise AllocFSError(403, "path escapes allocation directory")
+        return target
+
+    def list_files(self, alloc_id: str, rel_path: str = "") -> List[Dict]:
+        import os
+
+        target = self._resolve_fs_path(alloc_id, rel_path)
+        if not os.path.isdir(target):
+            raise AllocFSError(404, f"not a directory: {rel_path!r}")
+        out = []
+        for name in sorted(os.listdir(target)):
+            p = os.path.join(target, name)
+            st = os.stat(p)
+            out.append({
+                "Name": name,
+                "IsDir": os.path.isdir(p),
+                "Size": st.st_size,
+                "ModTime": st.st_mtime,
+            })
+        return out
+
+    def read_file(
+        self, alloc_id: str, rel_path: str, offset: int = 0,
+        limit: int = 1 << 20,
+    ) -> bytes:
+        """Read up to ``limit`` bytes at ``offset`` (negative = from EOF,
+        tail semantics)."""
+        import os
+
+        target = self._resolve_fs_path(alloc_id, rel_path)
+        if not os.path.isfile(target):
+            raise AllocFSError(404, f"no such file: {rel_path!r}")
+        with open(target, "rb") as fh:
+            if offset < 0:
+                fh.seek(0, os.SEEK_END)
+                fh.seek(max(0, fh.tell() + offset))
+            else:
+                fh.seek(offset)
+            return fh.read(limit)
+
+    @staticmethod
+    def task_log_path(task: str, log_type: str) -> str:
+        """Alloc-dir-relative path of a task's stdout/stderr (the drivers
+        write <task>/<task>.<type>)."""
+        if log_type not in ("stdout", "stderr"):
+            raise AllocFSError(400, f"bad log type {log_type!r}")
+        return f"{task}/{task}.{log_type}"
+

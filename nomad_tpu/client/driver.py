@@ -17,6 +17,7 @@ Two built-ins:
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -332,6 +333,240 @@ class _ReattachedProc:
             time.sleep(0.05)
 
 
+class SidecarClient:
+    """Handle to one executor sidecar process (client/executor.py).
+
+    The go-plugin analog: spawn a detached supervisor subprocess, talk
+    JSON-lines over its unix socket, and — when the sidecar is found dead
+    — spawn a replacement and hand it the dead one's task table to
+    recover by pid (reattach-config semantics)."""
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        self.sock_path = os.path.join(state_dir, "executor.sock")
+        self.state_path = os.path.join(state_dir, "executor.state.json")
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+
+    # -- wire -----------------------------------------------------------
+
+    def _call_raw(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        import socket as _socket
+
+        with _socket.socket(_socket.AF_UNIX) as s:
+            s.settimeout(30.0)
+            s.connect(self.sock_path)
+            s.sendall((json.dumps(payload) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        out = json.loads(buf)
+        if out.get("error"):
+            raise DriverError(out["error"])
+        return out
+
+    def call(self, op: str, **kw) -> Dict[str, Any]:
+        """One sidecar op; a dead sidecar is replaced (and its tasks
+        recovered) transparently."""
+        kw["op"] = op
+        with self._lock:
+            try:
+                return self._call_raw(kw)
+            except (OSError, ValueError):
+                self._respawn_locked()
+                return self._call_raw(kw)
+
+    def ensure_running(self) -> None:
+        with self._lock:
+            try:
+                self._call_raw({"op": "ping"})
+            except (OSError, ValueError):
+                self._respawn_locked()
+
+    def _respawn_locked(self) -> None:
+        # Read the DEAD sidecar's task table BEFORE the replacement
+        # truncates the state file.
+        orphans: Dict[str, Dict[str, Any]] = {}
+        try:
+            with open(self.state_path) as fh:
+                orphans = (json.loads(fh.read()) or {}).get("tasks", {})
+        except (OSError, ValueError):
+            pass
+        os.makedirs(self.state_dir, exist_ok=True)
+        import sys
+
+        self._proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "nomad_tpu.client.executor",
+                "--socket", self.sock_path,
+                "--state-dir", self.state_dir,
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,  # survives the agent
+        )
+        deadline = time.time() + 15.0
+        last: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                self._call_raw({"op": "ping"})
+                break
+            except (OSError, ValueError) as exc:
+                last = exc
+                time.sleep(0.05)
+        else:
+            raise DriverError(f"executor sidecar failed to start: {last}")
+        # Recover the orphaned (setsid'd, still-running) tasks by pid.
+        for tid, info in orphans.items():
+            try:
+                self._call_raw({
+                    "op": "recover", "id": tid,
+                    "pid": info["pid"], "start_ts": info.get("start_ts", 0),
+                })
+            except (OSError, ValueError, DriverError):
+                pass
+
+    def shutdown(self) -> None:
+        try:
+            with self._lock:
+                self._call_raw({"op": "shutdown"})
+        except (OSError, ValueError):
+            pass
+
+
+class ExecDriver(Driver):
+    """Isolated subprocess execution through the executor sidecar
+    (reference: drivers/exec/ over drivers/shared/executor/ — trimmed to
+    the no-privilege isolations: setsid, rlimits, best-effort cgroup v2).
+
+    Task config: ``command`` (required), ``args``, ``rlimits`` (map of
+    cpu/nofile/as/fsize/nproc → soft+hard value), ``cgroup`` (bool).
+    """
+
+    name = "exec"
+
+    def __init__(self, state_dir: str = ""):
+        self._state_dir = state_dir
+        self._sidecar: Optional[SidecarClient] = None
+        self._lock = threading.Lock()
+
+    def _get_sidecar(self, state_dir: str = "") -> SidecarClient:
+        with self._lock:
+            if self._sidecar is None:
+                sd = self._state_dir or state_dir
+                if not sd:
+                    raise DriverError("exec driver has no state dir yet")
+                self._state_dir = sd
+                self._sidecar = SidecarClient(os.path.join(sd, "executor"))
+                self._sidecar.ensure_running()
+            return self._sidecar
+
+    def start_task(self, handle: TaskHandle, task: Task, task_dir: str) -> None:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("exec requires config.command")
+        # The sidecar outlives agent restarts; the handle carries the
+        # state dir so recover_task can find it again.
+        state_dir = os.path.dirname(os.path.dirname(task_dir))
+        handle.config = {"state_dir": state_dir}
+        sidecar = self._get_sidecar(state_dir)
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in (task.env or {}).items()})
+        try:
+            out = sidecar.call(
+                "start",
+                id=handle.id,
+                argv=[str(command)] + [str(a) for a in cfg.get("args", [])],
+                cwd=task_dir,
+                env=env,
+                stdout=os.path.join(task_dir, f"{task.name}.stdout"),
+                stderr=os.path.join(task_dir, f"{task.name}.stderr"),
+                rlimits=cfg.get("rlimits") or {},
+                cgroup=bool(cfg.get("cgroup", True)),
+            )
+        except DriverError:
+            raise
+        except OSError as exc:
+            raise DriverError(f"sidecar unavailable: {exc}") from exc
+        handle.pid = int(out["pid"])
+        handle.started_at = float(out["start_ts"])
+
+    def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None):
+        sidecar = self._get_sidecar(handle.config.get("state_dir", ""))
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            try:
+                out = sidecar.call("wait", id=handle.id)
+            except (DriverError, OSError) as exc:
+                return ExitResult(err=f"sidecar lost task: {exc}")
+            if not out.get("running"):
+                return ExitResult(
+                    exit_code=int(out.get("exit_code", 0)),
+                    signal=int(out.get("signal", 0)),
+                )
+            if deadline is not None and time.time() >= deadline:
+                return None
+            time.sleep(0.05)
+
+    def stop_task(self, handle: TaskHandle, kill_timeout: float) -> None:
+        try:
+            self._get_sidecar(handle.config.get("state_dir", "")).call(
+                "stop", id=handle.id, grace=kill_timeout
+            )
+        except (DriverError, OSError):
+            pass
+
+    def destroy_task(self, handle: TaskHandle) -> None:
+        try:
+            self._get_sidecar(handle.config.get("state_dir", "")).call(
+                "destroy", id=handle.id
+            )
+        except (DriverError, OSError):
+            pass
+
+    def recover_task(self, handle: TaskHandle) -> bool:
+        """Agent restart: the sidecar (and the task) kept running.  If the
+        sidecar still supervises the task, done; if the sidecar died too,
+        the respawn path re-adopts the task by pid."""
+        state_dir = handle.config.get("state_dir", "")
+        if not state_dir:
+            return False
+        try:
+            sidecar = self._get_sidecar(state_dir)
+            out = sidecar.call("list")
+            info = out.get("tasks", {}).get(handle.id)
+            if info is not None:
+                return bool(info.get("running"))
+            if handle.pid and os.path.exists(f"/proc/{handle.pid}"):
+                got = sidecar.call(
+                    "recover", id=handle.id, pid=handle.pid,
+                    start_ts=handle.started_at,
+                )
+                return bool(got.get("ok"))
+        except (DriverError, OSError):
+            return False
+        return False
+
+    def inspect_task(self, handle: TaskHandle) -> str:
+        try:
+            out = self._get_sidecar(
+                handle.config.get("state_dir", "")
+            ).call("wait", id=handle.id)
+            return "running" if out.get("running") else "exited"
+        except (DriverError, OSError):
+            return "unknown"
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._sidecar is not None:
+                self._sidecar.shutdown()
+                self._sidecar = None
+
+
 class DriverRegistry:
     """Per-client driver instances (reference: client/pluginmanager/
     drivermanager — dispense + fingerprint)."""
@@ -340,6 +575,7 @@ class DriverRegistry:
         self.drivers: Dict[str, Driver] = drivers or {
             "mock": MockDriver(),
             "raw_exec": RawExecDriver(),
+            "exec": ExecDriver(),
         }
 
     def get(self, name: str) -> Driver:
@@ -353,3 +589,8 @@ class DriverRegistry:
         for d in self.drivers.values():
             attrs.update(d.fingerprint())
         return attrs
+
+    def shutdown(self) -> None:
+        for d in self.drivers.values():
+            if hasattr(d, "shutdown"):
+                d.shutdown()

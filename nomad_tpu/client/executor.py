@@ -1,0 +1,292 @@
+"""Executor sidecar — the process boundary under the exec driver.
+
+Reference: the reference runs every driver plugin and task executor as a
+separate OS process behind gRPC (go-plugin; ``drivers/shared/executor/``,
+``executor.proto``), with reattach state so agent restarts re-acquire
+running work.  This is the same shape in plain stdlib Python: a detached
+subprocess supervising task processes, speaking newline-delimited JSON
+over a unix socket.  A driver crash or agent crash therefore cannot take
+tasks down, and kill -9 of the sidecar itself leaves the (setsid'd) tasks
+running for the replacement sidecar to recover by pid.
+
+Protocol (one JSON object per line, {"op": ..., ...} → {"ok": ...}):
+
+  ping                                → {pong: true, pid}
+  start {id, argv, env, cwd, stdout, stderr, rlimits{...}} → {pid, start_ts}
+  wait {id}                           → {running} | {exit_code, signal}
+  stop {id, grace}                    → {} (SIGTERM, then SIGKILL at grace)
+  destroy {id}                        → {}
+  recover {id, pid, start_ts}         → {ok}  (poll-supervise a reparented
+                                         task from a dead sidecar's state)
+  list                                → {tasks: {id: {pid, start_ts}}}
+  shutdown                            → {} (exits; tasks keep running)
+
+Isolation on ``start`` (the executor_linux.go trimmings that need no
+privileges): ``setsid`` always (own session/process group, group kills),
+RLIMIT_* from the task config, and a cgroup v2 scope when
+``/sys/fs/cgroup`` is delegated and writable (best-effort).
+
+State: every mutation rewrites ``<dir>/executor.state.json`` with the
+supervised task table, so a REPLACEMENT sidecar can recover after
+kill -9 (the go-plugin reattach-config analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+_RLIMITS = {
+    "cpu": resource.RLIMIT_CPU,
+    "nofile": resource.RLIMIT_NOFILE,
+    "as": resource.RLIMIT_AS,
+    "fsize": resource.RLIMIT_FSIZE,
+    "nproc": resource.RLIMIT_NPROC,
+}
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+
+
+class _Supervised:
+    def __init__(self, pid: int, start_ts: float, proc=None):
+        self.pid = pid
+        self.start_ts = start_ts
+        self.proc = proc  # None for recovered (non-child) tasks
+        self.result = None  # (exit_code, signal) once done
+        self.cgroup = ""
+
+
+class ExecutorServer:
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        self.state_path = os.path.join(state_dir, "executor.state.json")
+        self.tasks: dict = {}
+        self.lock = threading.Lock()
+
+    # -- state file (reattach seam) -----------------------------------
+
+    def save_state(self) -> None:
+        with self.lock:
+            data = {
+                "pid": os.getpid(),
+                "tasks": {
+                    tid: {"pid": t.pid, "start_ts": t.start_ts}
+                    for tid, t in self.tasks.items()
+                    if t.result is None
+                },
+            }
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(data, fh)
+        os.replace(tmp, self.state_path)
+
+    # -- ops ------------------------------------------------------------
+
+    def op_ping(self, req):
+        return {"pong": True, "pid": os.getpid()}
+
+    def op_start(self, req):
+        rlimits = req.get("rlimits") or {}
+        cgroup = self._make_cgroup(req["id"]) if req.get("cgroup") else ""
+
+        def preexec():
+            os.setsid()
+            for name, value in rlimits.items():
+                res = _RLIMITS.get(name)
+                if res is not None:
+                    v = int(value)
+                    resource.setrlimit(res, (v, v))
+
+        stdout = open(req["stdout"], "ab")
+        stderr = open(req["stderr"], "ab")
+        try:
+            proc = subprocess.Popen(
+                req["argv"],
+                cwd=req.get("cwd") or None,
+                env=req.get("env") or None,
+                stdout=stdout,
+                stderr=stderr,
+                preexec_fn=preexec,
+            )
+        finally:
+            stdout.close()
+            stderr.close()
+        if cgroup:
+            try:
+                with open(os.path.join(cgroup, "cgroup.procs"), "w") as fh:
+                    fh.write(str(proc.pid))
+            except OSError:
+                cgroup = ""
+        sup = _Supervised(proc.pid, time.time(), proc)
+        sup.cgroup = cgroup
+        with self.lock:
+            self.tasks[req["id"]] = sup
+        self.save_state()
+        threading.Thread(
+            target=self._reap, args=(req["id"], sup), daemon=True
+        ).start()
+        return {"pid": proc.pid, "start_ts": sup.start_ts}
+
+    def _make_cgroup(self, task_id: str) -> str:
+        base = os.path.join(CGROUP_ROOT, "nomad_tpu")
+        path = os.path.join(base, task_id)
+        try:
+            os.makedirs(path, exist_ok=True)
+            return path
+        except OSError:
+            return ""  # not delegated — isolation degrades gracefully
+
+    def _reap(self, task_id: str, sup: _Supervised) -> None:
+        if sup.proc is not None:
+            code = sup.proc.wait()
+            sup.result = (
+                (code, 0) if code >= 0 else (0, -code)
+            )
+        else:
+            # Recovered task (not our child): poll for pid exit. Exit
+            # status is unobservable across the reparenting — report 0
+            # with the 'unknown' marker, like the reference's lost
+            # executor handles.
+            while _pid_alive(sup.pid):
+                time.sleep(0.2)
+            sup.result = (0, 0)
+        if sup.cgroup:
+            try:
+                os.rmdir(sup.cgroup)
+            except OSError:
+                pass
+        self.save_state()
+
+    def op_wait(self, req):
+        with self.lock:
+            sup = self.tasks.get(req["id"])
+        if sup is None:
+            return {"error": "unknown task"}
+        if sup.result is None:
+            return {"running": True}
+        return {
+            "exit_code": sup.result[0],
+            "signal": sup.result[1],
+            "recovered": sup.proc is None,
+        }
+
+    def op_stop(self, req):
+        with self.lock:
+            sup = self.tasks.get(req["id"])
+        if sup is None or sup.result is not None:
+            return {}
+        grace = float(req.get("grace", 5.0))
+        _kill_group(sup.pid, signal.SIGTERM)
+
+        def hard():
+            if sup.result is None:
+                _kill_group(sup.pid, signal.SIGKILL)
+
+        threading.Timer(grace, hard).start()
+        return {}
+
+    def op_destroy(self, req):
+        with self.lock:
+            sup = self.tasks.pop(req["id"], None)
+        if sup is not None and sup.result is None:
+            _kill_group(sup.pid, signal.SIGKILL)
+        self.save_state()
+        return {}
+
+    def op_recover(self, req):
+        pid = int(req["pid"])
+        if not _pid_alive(pid):
+            return {"ok": False}
+        sup = _Supervised(pid, float(req.get("start_ts", 0.0)), proc=None)
+        with self.lock:
+            self.tasks[req["id"]] = sup
+        self.save_state()
+        threading.Thread(
+            target=self._reap, args=(req["id"], sup), daemon=True
+        ).start()
+        return {"ok": True}
+
+    def op_list(self, req):
+        with self.lock:
+            return {
+                "tasks": {
+                    tid: {"pid": t.pid, "start_ts": t.start_ts,
+                          "running": t.result is None}
+                    for tid, t in self.tasks.items()
+                }
+            }
+
+    # -- server loop ------------------------------------------------------
+
+    def serve(self, sock_path: str) -> None:
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        srv = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                        op = req.get("op", "")
+                        if op == "shutdown":
+                            self.wfile.write(b"{}\n")
+                            self.wfile.flush()
+                            os._exit(0)
+                        fn = getattr(srv, f"op_{op}", None)
+                        out = (
+                            fn(req) if fn else {"error": f"bad op {op!r}"}
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        out = {"error": str(exc)}
+                    self.wfile.write(json.dumps(out).encode() + b"\n")
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self.save_state()
+        with Server(sock_path, Handler) as s:
+            s.serve_forever()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _kill_group(pid: int, sig: int) -> None:
+    try:
+        os.killpg(pid, sig)  # setsid'd: pid == pgid
+    except (ProcessLookupError, PermissionError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--state-dir", required=True)
+    args = ap.parse_args()
+    os.makedirs(args.state_dir, exist_ok=True)
+    ExecutorServer(args.state_dir).serve(args.socket)
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,9 @@ class GenericScheduler:
             batch=self.batch,
         )
         results = reconciler.compute()
+        # Annotations for `job plan` dry runs (scheduler/annotate.go:1-201
+        # via structs.DesiredUpdates).
+        self.last_desired_updates = dict(results.desired_tg_updates)
         # Placements made while an active same-version deployment is being
         # driven (next batches, canaries) attach to it (generic_sched.go
         # computePlacements deploymentID stamping).

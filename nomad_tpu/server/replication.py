@@ -101,6 +101,13 @@ class Replicator:
         self.peer_cooldown = peer_cooldown
 
         self._lock = threading.RLock()
+        # Serializes follower-side stream application (append/snapshot).
+        # Lock order: _stream_lock → store._lock; _lock is only ever held
+        # briefly for role/term/seq fields and NEVER while taking
+        # store._lock (the journaled write path holds store._lock and
+        # takes _lock inside replicate(), so the reverse order would be
+        # an ABBA deadlock across leadership changes).
+        self._stream_lock = threading.Lock()
         self.role = self.FOLLOWER
         self.term = 0
         self.voted_for: Optional[str] = None
@@ -177,8 +184,9 @@ class Replicator:
                 raise NotLeaderError(self.leader_addr)
             term = self.term
             prev_seq = self.last_seq
-            self.last_seq = entry["s"]
         if not self.peers:
+            with self._lock:
+                self.last_seq = entry["s"]
             return
         acks = 1  # self
         needed = self.quorum()
@@ -192,7 +200,9 @@ class Replicator:
         results: Dict[str, bool] = {}
 
         def send(p: PeerState) -> None:
-            results[p.addr] = self._send_entries(p, term, prev_seq, [entry])
+            results[p.addr] = self._send_entries(
+                p, term, prev_seq, [entry], allow_snapshot=False
+            )
 
         threads = [
             threading.Thread(target=send, args=(p,), daemon=True)
@@ -204,14 +214,20 @@ class Replicator:
             t.join(timeout=self.append_timeout + 1.0)
         acks += sum(1 for ok in results.values() if ok)
         if acks < needed:
-            # Lost quorum: step down so an up-to-date peer can take over.
+            # Lost quorum: the entry is NOT committed — last_seq stays at
+            # prev_seq (it was never advanced) so the log position still
+            # matches the WAL/store. Step down so an up-to-date peer can
+            # take over.
             self._step_down(term, reason="lost replication quorum")
             raise ReplicationError(
                 f"entry seq={entry['s']} acked by {acks}/{needed} servers"
             )
+        with self._lock:
+            self.last_seq = entry["s"]
 
     def _send_entries(
-        self, peer: PeerState, term: int, prev_seq: int, entries: List[Dict]
+        self, peer: PeerState, term: int, prev_seq: int, entries: List[Dict],
+        allow_snapshot: bool = True,
     ) -> bool:
         try:
             out = self._post(peer.addr, "/v1/internal/raft/append", {
@@ -230,6 +246,14 @@ class Replicator:
             self._observe_term(out["Term"])
             return False
         if out.get("NeedSnapshot"):
+            # The write path must NOT install inline: its caller holds the
+            # store lock, and to_snapshot_wire would self-deadlock across
+            # threads (and stall every write behind a full state transfer).
+            # The heartbeat loop — no locks held — does the catch-up.
+            if not allow_snapshot:
+                peer.healthy = False
+                peer.last_error = "needs snapshot catch-up"
+                return False
             return self._install_snapshot(peer, term)
         peer.healthy = bool(out.get("OK"))
         if peer.healthy:
@@ -267,36 +291,54 @@ class Replicator:
 
     def handle_append(self, body: Dict) -> Dict:
         term = int(body.get("Term", 0))
-        with self._lock:
-            if term < self.term:
-                return {"OK": False, "Term": self.term}
-            self._observe_leader_locked(
-                term, body.get("LeaderID", ""), body.get("LeaderAddr", "")
-            )
-            entries = body.get("Entries", [])
-            if int(body.get("PrevSeq", 0)) != self.last_seq:
-                return {
-                    "OK": False, "Term": self.term, "NeedSnapshot": True,
-                    "Seq": self.last_seq,
-                }
+        entries = body.get("Entries", [])
+        prev_seq = int(body.get("PrevSeq", 0))
+        with self._stream_lock:
+            with self._lock:
+                if term < self.term:
+                    return {"OK": False, "Term": self.term}
+                self._observe_leader_locked(
+                    term, body.get("LeaderID", ""),
+                    body.get("LeaderAddr", ""),
+                )
+                if entries:
+                    ok_prefix = prev_seq == self.last_seq
+                else:
+                    # Heartbeats tolerate being ahead of the leader's view:
+                    # the leader advances its last_seq only after quorum,
+                    # so a follower that just applied seq N legitimately
+                    # sees a heartbeat still stamped PrevSeq N-1.
+                    ok_prefix = self.last_seq >= prev_seq
+                if not ok_prefix:
+                    return {
+                        "OK": False, "Term": self.term,
+                        "NeedSnapshot": True, "Seq": self.last_seq,
+                    }
+            # Apply OUTSIDE self._lock (lock order: _stream_lock →
+            # store._lock; never store._lock under _lock — see __init__).
             for e in entries:
                 self.server.store.apply_remote(e)
-                self.last_seq = e["s"]
-            return {"OK": True, "Term": self.term, "Seq": self.last_seq}
+                with self._lock:
+                    self.last_seq = e["s"]
+            with self._lock:
+                return {"OK": True, "Term": self.term, "Seq": self.last_seq}
 
     def handle_snapshot_install(self, body: Dict) -> Dict:
         term = int(body.get("Term", 0))
-        with self._lock:
-            if term < self.term:
-                return {"OK": False, "Term": self.term}
-            self._observe_leader_locked(
-                term, body.get("LeaderID", ""), body.get("LeaderAddr", "")
-            )
+        with self._stream_lock:
+            with self._lock:
+                if term < self.term:
+                    return {"OK": False, "Term": self.term}
+                self._observe_leader_locked(
+                    term, body.get("LeaderID", ""),
+                    body.get("LeaderAddr", ""),
+                )
             self.server.store.install_snapshot(
                 body["Snapshot"], int(body.get("Seq", 0))
             )
-            self.last_seq = int(body.get("Seq", 0))
-            return {"OK": True, "Term": self.term}
+            with self._lock:
+                self.last_seq = int(body.get("Seq", 0))
+                return {"OK": True, "Term": self.term}
 
     def handle_vote(self, body: Dict) -> Dict:
         term = int(body.get("Term", 0))
@@ -306,7 +348,13 @@ class Replicator:
             if term < self.term:
                 return {"Granted": False, "Term": self.term}
             if term > self.term:
+                # A higher term deposes us regardless of how we learn of
+                # it (raft §5.1) — without the step-down, a leader that
+                # merely OBSERVES a higher-term vote request would keep
+                # role=leader at the new term: same-term split brain.
                 self._new_term_locked(term)
+                if self.role != self.FOLLOWER:
+                    self._become_follower_locked()
             up_to_date = cand_seq >= self.last_seq
             grant = self.voted_for in (None, candidate) and up_to_date
             if grant:

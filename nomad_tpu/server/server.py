@@ -33,6 +33,8 @@ from ..structs.types import (
     JobType,
     Node,
     NodeStatus,
+    Plan,
+    PlanResult,
     SchedulerConfiguration,
 )
 from .blocked_evals import BlockedEvals
@@ -68,6 +70,8 @@ class ServerConfig:
     core_gc_interval: float = 300.0
     # Max selects batched into one device dispatch (scheduler/coalescer.py).
     coalescer_lanes: int = 64
+    # ACL enforcement (acl/; nomad/server.go:88-91 token resolution).
+    acl_enabled: bool = False
     # Multi-server consensus (server/replication.py): peer HTTP addresses.
     # Empty = single-server (immediate leadership, no replication).
     server_id: str = ""
@@ -137,6 +141,7 @@ class Server:
         self._reaper: Optional[threading.Thread] = None
         self._shutdown = threading.Event()
         self.replicator = None  # set by setup_replication (multi-server)
+        self._acl_cache: Dict = {}
 
     # ------------------------------------------------------------------
     # Consensus (server/replication.py)
@@ -284,6 +289,153 @@ class Server:
         )
         self.apply_eval_updates([ev])
         return ev
+
+    # ------------------------------------------------------------------
+    # ACL (acl/ package; nomad/acl.go ResolveToken + 2Q cache — here a
+    # table-index-validated dict, same effect at this scale)
+    # ------------------------------------------------------------------
+
+    def bootstrap_acl(self):
+        """One-time creation of the initial management token
+        (ACL.Bootstrap, nomad/acl_endpoint.go)."""
+        from ..structs.types import ACLToken
+
+        with self.store._lock:
+            if self.store.has_management_token():
+                raise PermissionError("ACL already bootstrapped")
+            token = ACLToken(
+                name="Bootstrap Token", type="management",
+                create_time=time.time(),
+            )
+            self.store.upsert_acl_tokens(self.next_index(), [token])
+        return token
+
+    def resolve_token(self, secret_id: str):
+        """secret → compiled ACL. Empty secret resolves to the
+        ``anonymous`` policy (deny-all when undefined)."""
+        from ..acl import ACL, DENY_ALL_ACL, MANAGEMENT_ACL, parse_policy
+
+        if not self.config.acl_enabled:
+            return MANAGEMENT_ACL
+        cache_key = (
+            secret_id,
+            self.store.table_index("acl_token"),
+            self.store.table_index("acl_policy"),
+        )
+        cached = self._acl_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        if not secret_id:
+            anon = self.store.acl_policies.get("anonymous")
+            acl = ACL([parse_policy(anon.rules)]) if anon else DENY_ALL_ACL
+        else:
+            token = self.store.acl_token_by_secret(secret_id)
+            if token is None:
+                acl = None  # invalid secret: reject outright
+            elif token.is_management():
+                acl = MANAGEMENT_ACL
+            else:
+                policies = [
+                    self.store.acl_policies.get(name)
+                    for name in token.policies
+                ]
+                acl = ACL([
+                    parse_policy(p.rules) for p in policies if p is not None
+                ])
+        if acl is not None:  # never cache invalid-secret misses: a bad
+            # token retried in a loop would flush valid entries
+            if len(self._acl_cache) > 1024:
+                self._acl_cache.clear()
+            self._acl_cache[cache_key] = acl
+        return acl
+
+    def check_acl_capability(
+        self, token: str, kind: str, capability: str,
+        namespace: str = "default",
+    ) -> bool:
+        """Capability check on behalf of an agent that cannot resolve
+        tokens itself (client-only agents serving /v1/client/fs — the
+        reference forwards token resolution to servers the same way)."""
+        if not self.config.acl_enabled:
+            return True
+        acl = self.resolve_token(token)
+        if acl is None:
+            return False
+        if kind == "namespace":
+            return acl.allow_namespace(namespace, capability)
+        if kind == "node":
+            return acl.allow_node(capability)
+        if kind == "operator":
+            return acl.allow_operator(capability)
+        return acl.allow_agent(capability)
+
+    def plan_job(self, job: Job, diff: bool = False) -> Dict:
+        """`job plan` dry run (nomad/job_endpoint.go:1642 Plan +
+        scheduler/annotate.go): run the real scheduler against a pinned
+        snapshot with a recording planner — nothing commits — and return
+        per-TG create/update/destroy annotations, placement failures, and
+        (optionally) a coarse spec diff."""
+        from ..scheduler import new_scheduler
+
+        snap = self.store.snapshot()
+        prev = snap.job_by_id(job.namespace, job.id)
+        if prev is not None:
+            job.version = prev.version + (
+                1 if StateStore._job_spec_changed(prev, job) else 0
+            )
+        else:
+            job.version = 0
+
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by="job-plan",
+            job_id=job.id,
+            status=EvalStatus.PENDING.value,
+            annotate_plan=True,
+            snapshot_index=snap.snapshot_index,
+        )
+        planner = _DryRunPlanner(snap)
+        sched = new_scheduler(
+            job.type or JobType.SERVICE.value,
+            _ProposedJobSnapshot(snap, job),
+            planner,
+            self.matrix,
+        )
+        sched.process(ev)
+
+        from ..structs import serde
+
+        updated = planner.updated_eval
+        annotations = getattr(sched, "last_desired_updates", None)
+        if annotations is None:
+            # System scheduler: derive counts from the recorded plan.
+            annotations = {}
+            for plan in planner.plans:
+                for allocs in plan.node_allocation.values():
+                    for a in allocs:
+                        d = annotations.setdefault(a.task_group, {})
+                        d["place"] = d.get("place", 0) + 1
+                for allocs in plan.node_update.values():
+                    for a in allocs:
+                        d = annotations.setdefault(a.task_group, {})
+                        d["stop"] = d.get("stop", 0) + 1
+        out: Dict = {
+            "Annotations": {"DesiredTGUpdates": annotations},
+            "FailedTGAllocs": {
+                tg: serde.to_wire(m)
+                for tg, m in (
+                    updated.failed_tg_allocs if updated else {}
+                ).items()
+            },
+            "JobModifyIndex": prev.modify_index if prev else 0,
+            "CreatedEvals": len(planner.evals),
+            "Index": snap.snapshot_index,
+        }
+        if diff:
+            out["Diff"] = _job_diff(prev, job)
+        return out
 
     def deregister_job(
         self, namespace: str, job_id: str, purge: bool = False
@@ -756,3 +908,71 @@ class Server:
                 return ev
             time.sleep(0.01)
         return self.store.eval_by_id(eval_id)
+
+
+class _DryRunPlanner:
+    """Planner seam for `job plan`: records plans/evals instead of
+    committing (the scheduler.Harness pattern, scheduler/testing.go:83,
+    used by the reference's Plan endpoint against a snapshot)."""
+
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []
+        self.updated_eval: Optional[Evaluation] = None
+
+    def submit_plan(self, plan):
+        self.plans.append(plan)
+        result = PlanResult(
+            node_allocation=dict(plan.node_allocation),
+            node_update=dict(plan.node_update),
+            node_preemptions=dict(plan.node_preemptions),
+            deployment=plan.deployment,
+            deployment_updates=list(plan.deployment_updates),
+            alloc_index=self.snapshot.snapshot_index,
+        )
+        return result, None
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.updated_eval = ev
+
+    def create_evals(self, evals: List[Evaluation]) -> None:
+        self.evals.extend(evals)
+
+    def refresh_snapshot(self):
+        return self.snapshot
+
+
+class _ProposedJobSnapshot:
+    """Snapshot overlay that serves the PROPOSED job spec for its own id
+    and delegates every other read to the pinned snapshot."""
+
+    def __init__(self, snapshot, job: Job):
+        self._snapshot = snapshot
+        self._job = job
+
+    def job_by_id(self, namespace: str, job_id: str):
+        if (namespace, job_id) == (self._job.namespace, self._job.id):
+            return self._job
+        return self._snapshot.job_by_id(namespace, job_id)
+
+    def __getattr__(self, name):
+        return getattr(self._snapshot, name)
+
+
+def _job_diff(prev: Optional[Job], new: Job) -> Dict:
+    """Coarse spec diff for `job plan -diff` (structs.JobDiff trimmed to
+    type + changed top-level fields)."""
+    import dataclasses as _dc
+
+    if prev is None:
+        return {"Type": "Added", "Fields": []}
+    a = _dc.asdict(prev)
+    b = _dc.asdict(new)
+    skip = {"version", "create_index", "modify_index", "job_modify_index",
+            "submit_time", "status"}
+    changed = sorted(
+        k for k in set(a) | set(b)
+        if k not in skip and a.get(k) != b.get(k)
+    )
+    return {"Type": "Edited" if changed else "None", "Fields": changed}

@@ -37,6 +37,8 @@ import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..structs.types import (
+    ACLPolicy,
+    ACLToken,
     AllocClientStatus,
     AllocDesiredStatus,
     Allocation,
@@ -182,6 +184,10 @@ class StateStore:
         self.job_summaries: Dict[Tuple[str, str], JobSummary] = {}
         self.periodic_launch: Dict[Tuple[str, str], float] = {}
         self.scheduler_config = SchedulerConfiguration()
+        # ACL tables (acl_policy/acl_token, nomad/state/schema.go).
+        self.acl_policies: Dict[str, "ACLPolicy"] = {}
+        self.acl_tokens: Dict[str, "ACLToken"] = {}  # by accessor id
+        self._token_by_secret: Dict[str, str] = {}
 
         # Secondary indexes (sets of ids).
         self._allocs_by_node: Dict[str, Set[str]] = {}
@@ -890,6 +896,60 @@ class StateStore:
             self._bump("scheduler_config", index)
 
     # ------------------------------------------------------------------
+    # ACL (acl_policy/acl_token tables; nomad/state/state_store.go
+    # UpsertACLPolicies/UpsertACLTokens/BootstrapACLTokens)
+    # ------------------------------------------------------------------
+
+    @journaled
+    def upsert_acl_policy(self, index: int, policy: ACLPolicy) -> None:
+        with self._lock:
+            prev = self.acl_policies.get(policy.name)
+            policy.modify_index = index
+            policy.create_index = (
+                prev.create_index if prev is not None else index
+            )
+            self.acl_policies[policy.name] = policy
+            self._bump("acl_policy", index)
+
+    @journaled
+    def delete_acl_policy(self, index: int, name: str) -> None:
+        with self._lock:
+            if self.acl_policies.pop(name, None) is not None:
+                self._bump("acl_policy", index)
+
+    @journaled
+    def upsert_acl_tokens(
+        self, index: int, tokens: Iterable[ACLToken]
+    ) -> None:
+        with self._lock:
+            for token in tokens:
+                prev = self.acl_tokens.get(token.accessor_id)
+                token.modify_index = index
+                token.create_index = (
+                    prev.create_index if prev is not None else index
+                )
+                if prev is not None:
+                    self._token_by_secret.pop(prev.secret_id, None)
+                self.acl_tokens[token.accessor_id] = token
+                self._token_by_secret[token.secret_id] = token.accessor_id
+            self._bump("acl_token", index)
+
+    @journaled
+    def delete_acl_token(self, index: int, accessor_id: str) -> None:
+        with self._lock:
+            token = self.acl_tokens.pop(accessor_id, None)
+            if token is not None:
+                self._token_by_secret.pop(token.secret_id, None)
+                self._bump("acl_token", index)
+
+    def acl_token_by_secret(self, secret_id: str) -> Optional[ACLToken]:
+        accessor = self._token_by_secret.get(secret_id)
+        return self.acl_tokens.get(accessor) if accessor else None
+
+    def has_management_token(self) -> bool:
+        return any(t.is_management() for t in self.acl_tokens.values())
+
+    # ------------------------------------------------------------------
     # Plan results (UpsertPlanResults, state_store.go:318)
     # ------------------------------------------------------------------
 
@@ -992,6 +1052,9 @@ class StateStore:
         self._evals_by_job.clear()
         self._deployments_by_job.clear()
         self._history.clear()
+        self.acl_policies.clear()
+        self.acl_tokens.clear()
+        self._token_by_secret.clear()
 
     def to_snapshot_wire(self) -> dict:
         """Serialize the full FSM image (matrix excluded — it is rebuilt by
@@ -1017,6 +1080,12 @@ class StateStore:
                     for (ns, jid), t in self.periodic_launch.items()
                 ],
                 "scheduler_config": serde.to_wire(self.scheduler_config),
+                "acl_policies": [
+                    serde.to_wire(p) for p in self.acl_policies.values()
+                ],
+                "acl_tokens": [
+                    serde.to_wire(t) for t in self.acl_tokens.values()
+                ],
             }
 
     def write_snapshot(self) -> None:
@@ -1081,6 +1150,13 @@ class StateStore:
         for ns, jid, t in snap["periodic_launch"]:
             self.periodic_launch[(ns, jid)] = t
         self.scheduler_config = serde.from_wire(snap["scheduler_config"])
+        for w in snap.get("acl_policies", []):
+            p = serde.from_wire(w)
+            self.acl_policies[p.name] = p
+        for w in snap.get("acl_tokens", []):
+            t = serde.from_wire(w)
+            self.acl_tokens[t.accessor_id] = t
+            self._token_by_secret[t.secret_id] = t.accessor_id
         # Exact index fidelity last — replays bumped these monotonically.
         self.latest_index = snap["latest_index"]
         self._table_index = dict(snap["table_index"])

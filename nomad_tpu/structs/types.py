@@ -941,3 +941,34 @@ class SchedulerConfiguration:
     scheduler_algorithm: str = "binpack"  # "binpack" | "spread"
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
     memory_oversubscription_enabled: bool = False
+
+
+# ---------------------------------------------------------------------------
+# ACL (reference: acl/policy.go policy documents; structs.ACLPolicy /
+# ACLToken, nomad/structs/structs.go; token resolution nomad/acl.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ACLPolicy:
+    name: str = ""
+    description: str = ""
+    rules: str = ""  # HCL policy document (acl/policy.go grammar subset)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class ACLToken:
+    accessor_id: str = field(default_factory=generate_uuid)
+    secret_id: str = field(default_factory=generate_uuid)
+    name: str = ""
+    type: str = "client"  # "client" | "management"
+    policies: List[str] = field(default_factory=list)
+    global_: bool = True
+    create_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def is_management(self) -> bool:
+        return self.type == "management"

@@ -1,0 +1,177 @@
+"""Alloc logs + fs APIs (VERDICT r3 item 7): list/read task-dir files and
+stream task stdout/stderr, locally and forwarded server→node agent.
+
+Reference: command/agent/fs_endpoint.go (/v1/client/fs/*),
+nomad/client_rpc.go (server→client forwarding), command/alloc_logs.go.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu.api import Agent, AgentConfig
+from nomad_tpu.client import ClientConfig
+from nomad_tpu.jobspec import job_to_api, parse_job
+from nomad_tpu.server import ServerConfig
+
+LOG_JOB = """
+job "logger" {
+  datacenters = ["dc1"]
+  group "g" {
+    count = 1
+    ephemeral_disk { size = 10 }
+    task "main" {
+      driver = "raw_exec"
+      config {
+        command = "/bin/sh"
+        args = ["-c", "echo hello-logs; sleep 300"]
+      }
+      resources { cpu = 20 memory = 32 }
+    }
+  }
+}
+"""
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _run_logger(agent):
+    from nomad_tpu.api.client import APIClient
+
+    c = APIClient(agent.rpc_addr)
+    job = parse_job(LOG_JOB)
+    c.register_job(job_to_api(job))
+    assert _wait(lambda: [
+        a for a in c.job_allocations("logger")
+        if a["client_status"] == "running"
+    ], timeout=60)
+    return c.job_allocations("logger")[0]["id"]
+
+
+@pytest.fixture
+def combined_agent(tmp_path):
+    a = Agent(AgentConfig(
+        server_config=ServerConfig(
+            num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ),
+        client_config=ClientConfig(data_dir=str(tmp_path / "client")),
+    ))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+class TestLocalFS:
+    def test_ls_and_cat(self, combined_agent):
+        alloc_id = _run_logger(combined_agent)
+        addr = combined_agent.rpc_addr
+
+        _, body = _get(f"{addr}/v1/client/fs/ls/{alloc_id}")
+        names = {e["Name"] for e in json.loads(body)}
+        assert "main" in names and "alloc" in names
+
+        _, body = _get(f"{addr}/v1/client/fs/ls/{alloc_id}?path=main")
+        assert "main.stdout" in {e["Name"] for e in json.loads(body)}
+
+        assert _wait(lambda: b"hello-logs" in _get(
+            f"{addr}/v1/client/fs/cat/{alloc_id}?path=main/main.stdout"
+        )[1], timeout=15)
+
+    def test_path_escape_rejected(self, combined_agent):
+        alloc_id = _run_logger(combined_agent)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(
+                f"{combined_agent.rpc_addr}/v1/client/fs/cat/{alloc_id}"
+                "?path=../../etc/passwd"
+            )
+        assert e.value.code == 403
+
+    def test_logs_tail(self, combined_agent):
+        alloc_id = _run_logger(combined_agent)
+        assert _wait(lambda: b"hello-logs" in _get(
+            f"{combined_agent.rpc_addr}/v1/client/fs/logs/{alloc_id}"
+            "?task=main&type=stdout"
+        )[1], timeout=15)
+
+    def test_logs_follow_streams_appends(self, combined_agent, tmp_path):
+        from nomad_tpu.api.client import APIClient
+
+        c = APIClient(combined_agent.rpc_addr)
+        follow_job = LOG_JOB.replace(
+            "echo hello-logs; sleep 300",
+            "echo first; sleep 1; echo second; sleep 300",
+        ).replace('"logger"', '"follower"')
+        c.register_job(job_to_api(parse_job(follow_job)))
+        assert _wait(lambda: [
+            a for a in c.job_allocations("follower")
+            if a["client_status"] == "running"
+        ], timeout=60)
+        alloc_id = c.job_allocations("follower")[0]["id"]
+
+        url = (
+            f"{combined_agent.rpc_addr}/v1/client/fs/logs/{alloc_id}"
+            "?task=main&type=stdout&follow=true"
+        )
+        got = bytearray()
+
+        def reader():
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                while True:
+                    # read1: return what's available (read(n) would block
+                    # for a full n bytes on a live stream).
+                    chunk = resp.read1(64)
+                    if not chunk:
+                        return
+                    got.extend(chunk)
+                    if b"second" in got:
+                        return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        t.join(timeout=30)
+        assert b"first" in got and b"second" in got, bytes(got)
+
+
+def test_server_forwards_to_node_agent(tmp_path):
+    """`alloc logs` against a SERVER-only agent reaches the client agent
+    that holds the alloc (the reverse-session forwarding analog)."""
+    server_agent = Agent(AgentConfig(
+        name="srv", client_enabled=False,
+        server_config=ServerConfig(
+            num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ),
+    ))
+    server_agent.start()
+    client_agent = Agent(AgentConfig(
+        name="cli", server_enabled=False,
+        server_addr=server_agent.rpc_addr,
+        client_config=ClientConfig(data_dir=str(tmp_path / "c")),
+    ))
+    client_agent.start()
+    try:
+        alloc_id = _run_logger(server_agent)
+        # The server agent does NOT hold the alloc...
+        assert alloc_id not in (server_agent.client.allocs
+                                if server_agent.client else {})
+        # ...yet serves its logs by forwarding to the node's agent.
+        assert _wait(lambda: b"hello-logs" in _get(
+            f"{server_agent.rpc_addr}/v1/client/fs/logs/{alloc_id}"
+            "?task=main&type=stdout"
+        )[1], timeout=20)
+        _, body = _get(
+            f"{server_agent.rpc_addr}/v1/client/fs/ls/{alloc_id}?path=main"
+        )
+        assert "main.stdout" in {e["Name"] for e in json.loads(body)}
+    finally:
+        client_agent.shutdown()
+        server_agent.shutdown()

