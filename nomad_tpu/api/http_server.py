@@ -10,6 +10,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import re
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -74,6 +75,14 @@ class HTTPAPIServer:
                             "X-Nomad-Token", query.get("token", "")
                         )
                         api.stream_events(self, multi, token=stream_token)
+                        return
+                    if parsed.path == "/v1/agent/monitor" and (
+                        method == "GET"
+                    ):
+                        mon_token = self.headers.get(
+                            "X-Nomad-Token", query.get("token", "")
+                        )
+                        api.stream_monitor(self, query, token=mon_token)
                         return
                     if parsed.path.startswith("/v1/client/fs/") and (
                         method == "GET"
@@ -188,6 +197,20 @@ class HTTPAPIServer:
     # map here)
     # ------------------------------------------------------------------
 
+    def _require_ns_cap(
+        self, server, token: str, namespace: str, cap: str
+    ) -> None:
+        """Capability check against the namespace of the RESOURCE being
+        touched (the route gate can only see the query namespace; bodies
+        and looked-up objects carry their own)."""
+        if not server.config.acl_enabled:
+            return
+        acl = server.resolve_token(token)
+        if acl is None or not acl.allow_namespace(namespace, cap):
+            raise HTTPError(
+                403, f"Permission denied ({cap} on {namespace!r})"
+            )
+
     def _check_acl(
         self, server, method: str, path: str, query: Dict, token: str
     ) -> None:
@@ -199,6 +222,8 @@ class HTTPAPIServer:
         read = method == "GET"
         if path == "/v1/jobs/parse":
             return  # pure function of its input
+        if path == "/v1/search":
+            return  # per-context checks in the handler (needs the body)
         if path.startswith("/v1/acl"):
             if path == "/v1/acl/token/self":
                 return  # any valid token may read itself
@@ -218,6 +243,10 @@ class HTTPAPIServer:
                 raise HTTPError(403, f"Permission denied (operator:{want})")
             return
         if path == "/v1/jobs" or path.startswith("/v1/job"):
+            # The query namespace gates list/lookups (store keys are
+            # (namespace, id), so the queried ns IS the resource's); write
+            # bodies that carry their own Namespace are re-checked against
+            # it by the route handlers (_require_ns_cap).
             ns = query.get("namespace", "default")
             cap = CAP_READ_JOB if read else CAP_SUBMIT_JOB
             if not acl.allow_namespace(ns, cap):
@@ -305,6 +334,57 @@ class HTTPAPIServer:
         raise HTTPError(404, f"unknown ACL route {path}")
 
     # ------------------------------------------------------------------
+    # Live log monitor (reference: /v1/agent/monitor, command/agent/
+    # monitor/monitor.go — streams the agent's own logs at a level)
+    # ------------------------------------------------------------------
+
+    def stream_monitor(self, handler, query: Dict, token: str = "") -> None:
+        import logging
+        import queue as _queue
+
+        server = self.agent.server
+        if server is not None and server.config.acl_enabled:
+            acl = server.resolve_token(token)
+            if acl is None or not acl.allow_agent("read"):
+                raise HTTPError(403, "Permission denied (agent:read)")
+
+        level = getattr(
+            logging, query.get("log_level", "info").upper(), logging.INFO
+        )
+        q: "_queue.Queue" = _queue.Queue(maxsize=512)
+
+        class _Tap(logging.Handler):
+            def emit(self, record):
+                try:
+                    q.put_nowait({
+                        "Time": record.created,
+                        "Level": record.levelname,
+                        "Name": record.name,
+                        "Message": record.getMessage(),
+                    })
+                except _queue.Full:
+                    pass  # slow consumer: drop, never block the logger
+
+        tap = _Tap(level=level)
+        logging.getLogger().addHandler(tap)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            while True:
+                try:
+                    rec = q.get(timeout=10.0)
+                    handler.wfile.write(json.dumps(rec).encode() + b"\n")
+                except _queue.Empty:
+                    handler.wfile.write(b"{}\n")  # keepalive
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            logging.getLogger().removeHandler(tap)
+
+    # ------------------------------------------------------------------
     # Task filesystem + logs (reference: command/agent/fs_endpoint.go
     # /v1/client/fs/* — served by the agent holding the alloc, forwarded
     # by servers to the node's advertised agent address; the reference
@@ -317,20 +397,38 @@ class HTTPAPIServer:
         from ..acl import CAP_READ_FS, CAP_READ_LOGS
 
         cap = CAP_READ_LOGS if "/logs/" in path else CAP_READ_FS
-        ns = query.get("namespace", "default")
+
+        m = re.match(r"^/v1/client/fs/(ls|cat|logs)/([^/?]+)$", path)
+        if not m:
+            raise HTTPError(404, f"unknown fs route {path}")
+        op, alloc_id = m.group(1), m.group(2)
+
+        # The capability is checked against the ALLOCATION's namespace
+        # (a query parameter would let a token authorized in one namespace
+        # read another namespace's task files).
+        client = self.agent.client
         server = self.agent.server
+        ns = None
+        if client is not None and alloc_id in client.allocs:
+            ns = client.allocs[alloc_id].alloc.namespace
+        elif server is not None:
+            found = server.store.alloc_by_id(alloc_id)
+            if found is not None:
+                ns = found.namespace
+        if ns is None:
+            raise HTTPError(404, f"unknown allocation {alloc_id}")
         if server is not None:
             if server.config.acl_enabled:
                 acl = server.resolve_token(token)
                 if acl is None or not acl.allow_namespace(ns, cap):
                     raise HTTPError(403, f"Permission denied ({cap})")
-        elif self.agent.client is not None:
+        elif client is not None:
             # Client-only agent: it cannot resolve tokens itself — forward
             # the capability check to its server (the reference's clients
             # resolve ACLs via server RPC too). Reaching the node agent
             # directly must not bypass the ACLs the server enforces.
             try:
-                allowed = self.agent.client.server.check_acl_capability(
+                allowed = client.server.check_acl_capability(
                     token, "namespace", cap, ns
                 )
             except Exception as exc:  # noqa: BLE001 — fail closed
@@ -338,12 +436,6 @@ class HTTPAPIServer:
             if not allowed:
                 raise HTTPError(403, f"Permission denied ({cap})")
 
-        m = re.match(r"^/v1/client/fs/(ls|cat|logs)/([^/?]+)$", path)
-        if not m:
-            raise HTTPError(404, f"unknown fs route {path}")
-        op, alloc_id = m.group(1), m.group(2)
-
-        client = self.agent.client
         if client is None or alloc_id not in client.allocs:
             self._forward_client_fs(handler, path, query, alloc_id, token)
             return
@@ -581,6 +673,10 @@ class HTTPAPIServer:
             if payload is None:
                 raise HTTPError(400, "missing job")
             job = api_to_job(payload)
+            # The body carries its own namespace — re-check against IT.
+            from ..acl import CAP_SUBMIT_JOB
+
+            self._require_ns_cap(server, token, job.namespace, CAP_SUBMIT_JOB)
             ev = server.submit_job(job)
             return {"EvalID": ev.id if ev else "", "JobModifyIndex":
                     store.job_by_id(job.namespace, job.id).modify_index}
@@ -612,6 +708,9 @@ class HTTPAPIServer:
             job = api_to_job(payload)
             if job.id != m.group(1):
                 raise HTTPError(400, "job id does not match URL")
+            from ..acl import CAP_SUBMIT_JOB
+
+            self._require_ns_cap(server, token, job.namespace, CAP_SUBMIT_JOB)
             return server.plan_job(
                 job, diff=bool((body or {}).get("Diff", False))
             )
@@ -671,24 +770,38 @@ class HTTPAPIServer:
             return {"NodeModifyIndex": store.latest_index}
 
         if path == "/v1/evaluations" and method == "GET":
-            return _dump(list(store.evals.values()))
+            ns = query.get("namespace", "default")
+            return _dump([
+                e for e in store.evals.values() if e.namespace == ns
+            ])
         m = re.match(r"^/v1/evaluation/([^/]+)$", path)
         if m and method == "GET":
             ev = store.eval_by_id(m.group(1))
             if ev is None:
                 raise HTTPError(404, "eval not found")
+            from ..acl import CAP_READ_JOB
+
+            self._require_ns_cap(server, token, ev.namespace, CAP_READ_JOB)
             return _dump(ev)
         m = re.match(r"^/v1/evaluation/([^/]+)/allocations$", path)
         if m and method == "GET":
             return _dump(store.allocs_by_eval(m.group(1)), exclude=("job",))
 
         if path == "/v1/allocations" and method == "GET":
-            return _dump(list(store.allocs.values()), exclude=("job",))
+            ns = query.get("namespace", "default")
+            return _dump([
+                a for a in store.allocs.values() if a.namespace == ns
+            ], exclude=("job",))
         m = re.match(r"^/v1/allocation/([^/]+)$", path)
         if m and method == "GET":
             alloc = store.alloc_by_id(m.group(1))
             if alloc is None:
                 raise HTTPError(404, "alloc not found")
+            from ..acl import CAP_READ_JOB
+
+            self._require_ns_cap(
+                server, token, alloc.namespace, CAP_READ_JOB
+            )
             return _dump(alloc, exclude=("job",))
         m = re.match(r"^/v1/allocation/([^/]+)/stop$", path)
         if m and method in ("PUT", "POST"):
@@ -698,11 +811,104 @@ class HTTPAPIServer:
             return {"EvalID": ev.id}
 
         if path == "/v1/status/leader" and method == "GET":
-            return self.agent.rpc_addr
+            rep = store.replicator
+            return rep.leader_addr if rep is not None else self.agent.rpc_addr
         if path == "/v1/agent/members" and method == "GET":
             return {"Members": [self.agent.member_info()]}
         if path == "/v1/agent/self" and method == "GET":
             return self.agent.member_info()
+        if path == "/v1/agent/profile" and method == "GET":
+            # Thread stack dump — the pprof-goroutine analog
+            # (command/agent/pprof/pprof.go) for a Python runtime.
+            import traceback as _tb
+
+            frames = sys._current_frames()
+            out = {}
+            for t in threading.enumerate():
+                frame = frames.get(t.ident)
+                out[t.name] = (
+                    _tb.format_stack(frame) if frame is not None else []
+                )
+            return {"Threads": out, "Count": len(out)}
+
+        # ---- search (nomad/search_endpoint.go: prefix matches across
+        # contexts, truncated at 20 per context) ----
+        if path == "/v1/search" and method in ("PUT", "POST"):
+            prefix = (body or {}).get("Prefix", "")
+            context = (body or {}).get("Context", "all")
+            ns = (body or {}).get("Namespace", "default")
+            # Per-context capability gating (search_endpoint.go
+            # sufficientSearchPerms): namespace contexts need read-job on
+            # the searched namespace, nodes need node:read; a token with
+            # neither gets 403 rather than an empty sweep.
+            ns_ok = node_ok = True
+            if server.config.acl_enabled:
+                acl = server.resolve_token(token)
+                if acl is None:
+                    raise HTTPError(403, "ACL token not found")
+                from ..acl import CAP_READ_JOB
+
+                ns_ok = acl.allow_namespace(ns, CAP_READ_JOB)
+                node_ok = acl.allow_node("read")
+                if not ns_ok and not node_ok:
+                    raise HTTPError(403, "Permission denied (search)")
+            matches: Dict[str, List[str]] = {}
+            truncations: Dict[str, bool] = {}
+
+            def collect(name: str, ids):
+                hits = [i for i in ids if i.startswith(prefix)]
+                matches[name] = sorted(hits)[:20]
+                truncations[name] = len(hits) > 20
+
+            if not ns_ok:
+                context = "nodes"
+            elif not node_ok and context == "all":
+                pass  # nodes skipped below
+            if context in ("all", "jobs"):
+                collect("jobs", [
+                    jid for (jns, jid) in store.jobs if jns == ns
+                ])
+            if context in ("all", "nodes") and node_ok:
+                collect("nodes", list(store.nodes))
+            if context in ("all", "allocs"):
+                collect("allocs", [
+                    a.id for a in store.allocs.values()
+                    if a.namespace == ns
+                ])
+            if context in ("all", "evals"):
+                collect("evals", [
+                    e.id for e in store.evals.values()
+                    if e.namespace == ns
+                ])
+            if context in ("all", "deployment"):
+                collect("deployment", [
+                    d.id for d in store.deployments.values()
+                    if d.namespace == ns
+                ])
+            return {"Matches": matches, "Truncations": truncations}
+
+        # ---- namespaces (nomad/namespace_endpoint.go) ----
+        if path == "/v1/namespaces" and method == "GET":
+            return sorted(store.namespaces.values(), key=lambda n: n["Name"])
+        m = re.match(r"^/v1/namespace/([^/]+)$", path)
+        if m:
+            if method == "GET":
+                ns_obj = store.namespaces.get(m.group(1))
+                if ns_obj is None:
+                    raise HTTPError(404, "namespace not found")
+                return ns_obj
+            if method in ("PUT", "POST"):
+                store.upsert_namespace(
+                    server.next_index(), m.group(1),
+                    (body or {}).get("Description", ""),
+                )
+                return {}
+            if method == "DELETE":
+                try:
+                    store.delete_namespace(server.next_index(), m.group(1))
+                except ValueError as exc:
+                    raise HTTPError(400, str(exc))
+                return {}
 
         if path == "/v1/operator/scheduler/configuration":
             if method == "GET":

@@ -29,20 +29,32 @@ def _print(obj) -> None:
 
 def cmd_agent(args) -> int:
     from .api.agent import Agent, AgentConfig
-    from .server.server import ServerConfig
+    from .api.config_file import apply_config, load_config_files
 
-    config = AgentConfig(
-        name=args.name,
-        datacenter=args.dc,
-        server_enabled=not args.client_only,
-        client_enabled=not args.server_only,
-        server_addr=args.servers,
-        http_host=args.bind,
-        http_port=args.port,
-        server_config=ServerConfig(
-            num_workers=args.workers, data_dir=args.data_dir or None
-        ),
-    )
+    # Precedence (command/agent/config.go): defaults < config files
+    # (merged in order) < explicitly passed CLI flags.  Flags default to
+    # None so "explicitly passed" is distinguishable from "defaulted".
+    config = AgentConfig(http_port=4646)
+    if args.config:
+        apply_config(load_config_files(args.config), config)
+    if args.name is not None:
+        config.name = args.name
+    if args.dc is not None:
+        config.datacenter = args.dc
+    if args.client_only:
+        config.server_enabled = False
+    if args.server_only:
+        config.client_enabled = False
+    if args.servers is not None:
+        config.server_addr = args.servers
+    if args.bind is not None:
+        config.http_host = args.bind
+    if args.port is not None:
+        config.http_port = args.port
+    if args.workers is not None:
+        config.server_config.num_workers = args.workers
+    if args.data_dir:
+        config.server_config.data_dir = args.data_dir
     agent = Agent(config)
     agent.start()
     print(f"agent started; HTTP API at {agent.rpc_addr}")
@@ -336,14 +348,18 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
 
     agent = sub.add_parser("agent", help="run an agent (server+client)")
-    agent.add_argument("--name", default="agent-1")
-    agent.add_argument("--dc", default="dc1")
-    agent.add_argument("--bind", default="127.0.0.1")
-    agent.add_argument("--port", type=int, default=4646)
-    agent.add_argument("--workers", type=int, default=2)
+    # Flags default to None so config files only lose to EXPLICIT flags
+    # (cmd_agent precedence chain).
+    agent.add_argument("--name", default=None)
+    agent.add_argument("--config", action="append", default=[],
+                       help="config file or dir (repeatable; merged in order)")
+    agent.add_argument("--dc", default=None)
+    agent.add_argument("--bind", default=None)
+    agent.add_argument("--port", type=int, default=None)
+    agent.add_argument("--workers", type=int, default=None)
     agent.add_argument("--server-only", action="store_true")
     agent.add_argument("--client-only", action="store_true")
-    agent.add_argument("--servers", default="",
+    agent.add_argument("--servers", default=None,
                        help="server agent address for client-only agents")
     agent.add_argument("--data-dir", default="",
                        help="server durability dir (WAL + snapshots)")

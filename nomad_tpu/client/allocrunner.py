@@ -35,10 +35,12 @@ class AllocRunner:
         drivers: DriverRegistry,
         data_dir: str,
         on_alloc_update: Callable[["AllocRunner"], None],
+        node=None,
     ):
         self.alloc = alloc
         self.drivers = drivers
         self.on_alloc_update = on_alloc_update
+        self.node = node  # for ${attr.*}/${node.*} interpolation
         self.alloc_dir = os.path.join(data_dir, alloc.id)
         self.client_status = AllocClientStatus.PENDING.value
         self.task_states: Dict[str, TaskState] = {}
@@ -90,11 +92,18 @@ class AllocRunner:
         poststop = [t for t in tasks if t.lifecycle_hook == "poststop"]
 
         def launch(task: Task) -> TaskRunner:
+            from .taskenv import interpolated_task
+
+            task_dir = os.path.join(self.alloc_dir, task.name)
             tr = TaskRunner(
                 alloc_id=self.alloc.id,
-                task=task,
+                # The driver sees the fully built NOMAD_* env and resolved
+                # ${...} references (client/taskenv/ hook).
+                task=interpolated_task(
+                    task, self.alloc, task_dir, self.alloc_dir, self.node
+                ),
                 driver=self.drivers.get(task.driver),
-                task_dir=os.path.join(self.alloc_dir, task.name),
+                task_dir=task_dir,
                 restart_policy=restart or tg.restart_policy,
                 on_state_change=self._on_task_state,
             )
@@ -193,11 +202,16 @@ class AllocRunner:
                 handle = TaskHandle(**known)
             driver = self.drivers.get(task.driver)
             if handle is not None and driver.recover_task(handle):
+                from .taskenv import interpolated_task
+
+                task_dir = os.path.join(self.alloc_dir, task.name)
                 tr = TaskRunner(
                     alloc_id=self.alloc.id,
-                    task=task,
+                    task=interpolated_task(
+                        task, self.alloc, task_dir, self.alloc_dir, self.node
+                    ),
                     driver=driver,
-                    task_dir=os.path.join(self.alloc_dir, task.name),
+                    task_dir=task_dir,
                     restart_policy=restart,
                     on_state_change=self._on_task_state,
                 )

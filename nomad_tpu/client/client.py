@@ -153,7 +153,8 @@ class Client:
                 self.state_db.delete_alloc(alloc.id)
                 continue
             ar = AllocRunner(
-                alloc, self.drivers, self.data_dir, self._alloc_updated
+                alloc, self.drivers, self.data_dir, self._alloc_updated,
+                node=self.node,
             )
             with self._lock:
                 self.allocs[alloc.id] = ar
@@ -222,7 +223,8 @@ class Client:
                 if alloc.desired_status != AllocDesiredStatus.RUN.value:
                     continue
                 ar = AllocRunner(
-                    alloc, self.drivers, self.data_dir, self._alloc_updated
+                    alloc, self.drivers, self.data_dir, self._alloc_updated,
+                    node=self.node,
                 )
                 with self._lock:
                     self.allocs[aid] = ar

@@ -370,12 +370,19 @@ class SidecarClient:
 
     def call(self, op: str, **kw) -> Dict[str, Any]:
         """One sidecar op; a dead sidecar is replaced (and its tasks
-        recovered) transparently."""
+        recovered) transparently — EXCEPT for ``start``, which is not
+        idempotent: a lost start response retried against a respawned
+        sidecar could launch the task twice (the first copy running
+        unsupervised).  Start failures surface to the restart policy."""
         kw["op"] = op
         with self._lock:
             try:
                 return self._call_raw(kw)
-            except (OSError, ValueError):
+            except (OSError, ValueError) as exc:
+                if op == "start":
+                    raise DriverError(
+                        f"sidecar start failed/indeterminate: {exc}"
+                    ) from exc
                 self._respawn_locked()
                 return self._call_raw(kw)
 
@@ -474,6 +481,9 @@ class ExecDriver(Driver):
         state_dir = os.path.dirname(os.path.dirname(task_dir))
         handle.config = {"state_dir": state_dir}
         sidecar = self._get_sidecar(state_dir)
+        # Preflight: a dead sidecar respawns HERE (idempotent ping), so
+        # the non-retryable start below runs against a live one.
+        sidecar.ensure_running()
         env = dict(os.environ)
         env.update({k: str(v) for k, v in (task.env or {}).items()})
         try:

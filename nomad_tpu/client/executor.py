@@ -95,6 +95,12 @@ class ExecutorServer:
         return {"pong": True, "pid": os.getpid()}
 
     def op_start(self, req):
+        # Idempotent by task id: a retried start (lost response) must not
+        # launch a second copy.
+        with self.lock:
+            existing = self.tasks.get(req["id"])
+        if existing is not None and existing.result is None:
+            return {"pid": existing.pid, "start_ts": existing.start_ts}
         rlimits = req.get("rlimits") or {}
         cgroup = self._make_cgroup(req["id"]) if req.get("cgroup") else ""
 

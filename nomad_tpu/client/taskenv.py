@@ -1,0 +1,130 @@
+"""Task environment — NOMAD_* variables + ${...} interpolation.
+
+Reference: ``client/taskenv/`` (1361 LoC): the env builder exposes alloc/
+task/node identity, resource limits, ports, and metadata to tasks as
+NOMAD_* variables, and interpolates ``${attr.*}`` / ``${node.*}`` /
+``${meta.*}`` / ``${env.*}`` / ``${NOMAD_*}`` references inside task env
+values and driver config.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional
+
+from ..structs.types import Allocation, Node, Task
+
+_REF = re.compile(r"\$\{([^}]+)\}")
+
+
+def build_task_env(
+    alloc: Allocation,
+    task: Task,
+    task_dir: str,
+    alloc_dir: str,
+    node: Optional[Node] = None,
+) -> Dict[str, str]:
+    """The NOMAD_* environment for one task (taskenv.Builder.Build)."""
+    job = alloc.job
+    env: Dict[str, str] = {
+        "NOMAD_ALLOC_ID": alloc.id,
+        "NOMAD_ALLOC_NAME": alloc.name,
+        "NOMAD_ALLOC_INDEX": str(_alloc_index(alloc.name)),
+        "NOMAD_ALLOC_DIR": f"{alloc_dir}/alloc",
+        "NOMAD_TASK_DIR": task_dir,
+        "NOMAD_SECRETS_DIR": f"{task_dir}/secrets",
+        "NOMAD_TASK_NAME": task.name,
+        "NOMAD_GROUP_NAME": alloc.task_group,
+        "NOMAD_JOB_ID": alloc.job_id,
+        "NOMAD_JOB_NAME": job.name if job else alloc.job_id,
+        "NOMAD_NAMESPACE": alloc.namespace,
+        "NOMAD_CPU_LIMIT": str(int(task.resources.cpu)),
+        "NOMAD_MEMORY_LIMIT": str(int(task.resources.memory_mb)),
+    }
+    if job is not None:
+        env["NOMAD_DC"] = job.datacenters[0] if job.datacenters else ""
+        env["NOMAD_REGION"] = job.region
+        for k, v in (job.meta or {}).items():
+            env[f"NOMAD_META_{_sanitize(k)}"] = str(v)
+    if node is not None:
+        env["NOMAD_NODE_ID"] = node.id
+        env["NOMAD_NODE_NAME"] = node.name
+        env["NOMAD_NODE_CLASS"] = node.node_class
+    # Ports (taskenv network vars): NOMAD_PORT_<label>, NOMAD_ADDR_<label>,
+    # NOMAD_HOST_PORT_<label>.
+    for per_owner in (alloc.assigned_ports or {}).values():
+        for label, port in per_owner.items():
+            lab = _sanitize(label)
+            env[f"NOMAD_PORT_{lab}"] = str(port)
+            env[f"NOMAD_HOST_PORT_{lab}"] = str(port)
+            env[f"NOMAD_ADDR_{lab}"] = f"127.0.0.1:{port}"
+    return env
+
+
+def interpolation_map(
+    env: Dict[str, str], node: Optional[Node] = None
+) -> Dict[str, str]:
+    """Lookup table for ${...} references (taskenv.ReplaceEnv targets)."""
+    out: Dict[str, str] = {}
+    for k, v in env.items():
+        out[k] = v
+        out[f"env.{k}"] = v
+    if node is not None:
+        from ..state.matrix import node_attributes
+
+        for name, value in node_attributes(node).items():
+            out[f"attr.{name}"] = str(value)
+        out["node.unique.id"] = node.id
+        out["node.unique.name"] = node.name
+        out["node.datacenter"] = node.datacenter
+        out["node.class"] = node.node_class
+        for k, v in (node.meta or {}).items():
+            out[f"meta.{k}"] = str(v)
+    return out
+
+
+def interpolate(value: Any, table: Dict[str, str]) -> Any:
+    """Replace ${ref} in strings (recursing through lists/dicts); unknown
+    references are left intact, matching the reference's behavior."""
+    if isinstance(value, str):
+        return _REF.sub(
+            lambda m: table.get(m.group(1).strip(), m.group(0)), value
+        )
+    if isinstance(value, list):
+        return [interpolate(v, table) for v in value]
+    if isinstance(value, dict):
+        return {k: interpolate(v, table) for k, v in value.items()}
+    return value
+
+
+def interpolated_task(
+    task: Task,
+    alloc: Allocation,
+    task_dir: str,
+    alloc_dir: str,
+    node: Optional[Node] = None,
+) -> Task:
+    """A COPY of the task with the full NOMAD_* env merged in and every
+    ${...} reference in env/config resolved — what the driver receives."""
+    import copy
+
+    env = build_task_env(alloc, task, task_dir, alloc_dir, node)
+    table = interpolation_map(env, node)
+    out = copy.copy(task)
+    merged = dict(env)
+    for k, v in (task.env or {}).items():
+        merged[k] = interpolate(str(v), table)
+    out.env = merged
+    out.config = interpolate(dict(task.config or {}), table)
+    out.artifacts = interpolate(list(task.artifacts or []), table)
+    out.templates = interpolate(list(task.templates or []), table)
+    return out
+
+
+def _alloc_index(name: str) -> int:
+    m = re.search(r"\[(\d+)\]$", name or "")
+    return int(m.group(1)) if m else 0
+
+
+def _sanitize(key: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_]", "_", key)

@@ -141,12 +141,76 @@ class TaskRunner:
         self._done.set()
 
     def _prestart_hooks(self) -> None:
-        """validate + taskdir hooks (task_runner_hooks.go:50-160, trimmed:
-        no logmon/artifact/template/vault machinery yet)."""
+        """validate + taskdir + artifact + template hooks
+        (task_runner_hooks.go:50-160; references resolved earlier by
+        client/taskenv interpolation)."""
         self._event(EVENT_TASK_SETUP)
         if not self.task.driver:
             raise ValueError("task has no driver")
         os.makedirs(self.task_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.task_dir, "secrets"), exist_ok=True)
+        os.makedirs(os.path.join(self.task_dir, "local"), exist_ok=True)
+        for art in self.task.artifacts or []:
+            self._fetch_artifact(art)
+        for tpl in self.task.templates or []:
+            self._render_template(tpl)
+
+    def _inside_task_dir(self, path: str) -> bool:
+        """Sandbox check with a separator suffix — bare startswith would
+        accept sibling dirs sharing the task dir's name as a prefix."""
+        base = os.path.realpath(self.task_dir)
+        target = os.path.realpath(path)
+        return target == base or target.startswith(base + os.sep)
+
+    def _fetch_artifact(self, art: dict) -> None:
+        """Artifact hook (task_runner_hooks.go artifact → go-getter,
+        trimmed to file:// and http(s):// sources)."""
+        import shutil
+        import urllib.parse
+        import urllib.request
+
+        source = str(art.get("source", ""))
+        if not source:
+            raise ValueError("artifact has no source")
+        dest_dir = os.path.join(
+            self.task_dir, str(art.get("destination", "local"))
+        )
+        if not self._inside_task_dir(dest_dir):
+            raise ValueError("artifact destination escapes task dir")
+        os.makedirs(dest_dir, exist_ok=True)
+        parsed = urllib.parse.urlparse(source)
+        name = os.path.basename(parsed.path) or "artifact"
+        target = os.path.join(dest_dir, name)
+        if parsed.scheme in ("", "file"):
+            shutil.copy(parsed.path, target)
+        elif parsed.scheme in ("http", "https"):
+            with urllib.request.urlopen(source, timeout=60) as resp, open(
+                target, "wb"
+            ) as out:
+                shutil.copyfileobj(resp, out)
+        else:
+            raise ValueError(f"unsupported artifact scheme {parsed.scheme!r}")
+        if art.get("mode"):
+            os.chmod(target, int(str(art["mode"]), 8))
+
+    def _render_template(self, tpl: dict) -> None:
+        """Template hook (client/allocrunner/taskrunner/template/): inline
+        ``data`` or a ``source`` file rendered into ``destination``.
+        ${...} references were resolved by taskenv interpolation."""
+        dest = os.path.join(
+            self.task_dir, str(tpl.get("destination", "local/template"))
+        )
+        if not self._inside_task_dir(dest):
+            raise ValueError("template destination escapes task dir")
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        data = tpl.get("data")
+        if data is None and tpl.get("source"):
+            with open(str(tpl["source"])) as fh:
+                data = fh.read()
+        with open(dest, "w") as fh:
+            fh.write(str(data or ""))
+        if tpl.get("perms"):
+            os.chmod(dest, int(str(tpl["perms"]), 8))
 
     def _run_once(
         self, attached: Optional[TaskHandle] = None

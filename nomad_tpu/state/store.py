@@ -188,6 +188,11 @@ class StateStore:
         self.acl_policies: Dict[str, "ACLPolicy"] = {}
         self.acl_tokens: Dict[str, "ACLToken"] = {}  # by accessor id
         self._token_by_secret: Dict[str, str] = {}
+        # Namespaces (nomad/state/schema.go namespaces table); "default"
+        # always exists.
+        self.namespaces: Dict[str, Dict] = {
+            "default": {"Name": "default", "Description": "Default namespace"}
+        }
 
         # Secondary indexes (sets of ids).
         self._allocs_by_node: Dict[str, Set[str]] = {}
@@ -942,6 +947,28 @@ class StateStore:
                 self._token_by_secret.pop(token.secret_id, None)
                 self._bump("acl_token", index)
 
+    @journaled
+    def upsert_namespace(self, index: int, name: str, description: str = "") -> None:
+        with self._lock:
+            self.namespaces[name] = {
+                "Name": name, "Description": description,
+                "CreateIndex": self.namespaces.get(name, {}).get(
+                    "CreateIndex", index
+                ),
+                "ModifyIndex": index,
+            }
+            self._bump("namespaces", index)
+
+    @journaled
+    def delete_namespace(self, index: int, name: str) -> None:
+        with self._lock:
+            if name == "default":
+                raise ValueError("cannot delete the default namespace")
+            if any(ns == name for ns, _ in self.jobs):
+                raise ValueError(f"namespace {name!r} has jobs")
+            if self.namespaces.pop(name, None) is not None:
+                self._bump("namespaces", index)
+
     def acl_token_by_secret(self, secret_id: str) -> Optional[ACLToken]:
         accessor = self._token_by_secret.get(secret_id)
         return self.acl_tokens.get(accessor) if accessor else None
@@ -1055,6 +1082,9 @@ class StateStore:
         self.acl_policies.clear()
         self.acl_tokens.clear()
         self._token_by_secret.clear()
+        self.namespaces = {
+            "default": {"Name": "default", "Description": "Default namespace"}
+        }
 
     def to_snapshot_wire(self) -> dict:
         """Serialize the full FSM image (matrix excluded — it is rebuilt by
@@ -1086,6 +1116,7 @@ class StateStore:
                 "acl_tokens": [
                     serde.to_wire(t) for t in self.acl_tokens.values()
                 ],
+                "namespaces": dict(self.namespaces),
             }
 
     def write_snapshot(self) -> None:
@@ -1157,6 +1188,7 @@ class StateStore:
             t = serde.from_wire(w)
             self.acl_tokens[t.accessor_id] = t
             self._token_by_secret[t.secret_id] = t.accessor_id
+        self.namespaces.update(snap.get("namespaces", {}))
         # Exact index fidelity last — replays bumped these monotonically.
         self.latest_index = snap["latest_index"]
         self._table_index = dict(snap["table_index"])

@@ -229,3 +229,28 @@ job "aclogger" {
   }
 }
 """
+
+
+def test_body_namespace_cannot_bypass_token_scope(acl_agent):
+    """A token scoped to one namespace must not write into another by
+    carrying the target namespace in the request BODY (the route gate can
+    only see the query string)."""
+    c = APIClient(acl_agent.rpc_addr)
+    boot = c.acl_bootstrap()
+    mgmt = APIClient(acl_agent.rpc_addr, token=boot["secret_id"])
+    mgmt.acl_upsert_policy(
+        "default-only", 'namespace "default" { policy = "write" }'
+    )
+    tok = mgmt.acl_create_token(name="scoped", policies=["default-only"])
+    scoped = APIClient(acl_agent.rpc_addr, token=tok["secret_id"])
+
+    job = parse_job(JOB_HCL)
+    payload = job_to_api(job)
+    payload["namespace"] = "prod"  # body smuggles the target namespace
+    with pytest.raises(APIError) as e:
+        scoped.register_job(payload)
+    assert e.value.code == 403
+    assert acl_agent.server.store.job_by_id("prod", job.id) is None
+    with pytest.raises(APIError) as e:
+        scoped.plan_job(job.id, payload)
+    assert e.value.code == 403

@@ -1,0 +1,156 @@
+"""Search, namespaces, agent monitor/profile, config files (VERDICT r3
+missing items 9-10).
+
+Reference: nomad/search_endpoint.go, nomad/namespace_endpoint.go,
+command/agent/monitor/monitor.go, command/agent/pprof/pprof.go,
+command/agent/config_parse.go.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu import mock
+from nomad_tpu.api.client import APIClient, APIError
+
+
+@pytest.fixture
+def agent(tmp_path):
+    from nomad_tpu.api import Agent, AgentConfig
+    from nomad_tpu.client import ClientConfig
+    from nomad_tpu.server import ServerConfig
+
+    a = Agent(AgentConfig(
+        server_config=ServerConfig(
+            num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ),
+        client_config=ClientConfig(data_dir=str(tmp_path / "client")),
+    ))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def _post(addr, path, body):
+    req = urllib.request.Request(
+        addr + path, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return json.loads(resp.read())
+
+
+class TestSearch:
+    def test_prefix_search_across_contexts(self, agent):
+        srv = agent.server
+        job = mock.job()
+        srv.submit_job(job)
+        out = _post(agent.rpc_addr, "/v1/search", {
+            "Prefix": job.id[:6], "Context": "all",
+        })
+        assert job.id in out["Matches"]["jobs"]
+        node_id = agent.client.node.id
+        out = _post(agent.rpc_addr, "/v1/search", {
+            "Prefix": node_id[:8], "Context": "nodes",
+        })
+        assert node_id in out["Matches"]["nodes"]
+        assert out["Truncations"]["nodes"] is False
+
+
+class TestNamespaces:
+    def test_crud(self, agent):
+        addr = agent.rpc_addr
+        _post(addr, "/v1/namespace/prod", {"Description": "production"})
+        with urllib.request.urlopen(addr + "/v1/namespaces") as resp:
+            names = {n["Name"] for n in json.loads(resp.read())}
+        assert names == {"default", "prod"}
+        req = urllib.request.Request(
+            addr + "/v1/namespace/prod", method="DELETE"
+        )
+        urllib.request.urlopen(req, timeout=15)
+        with urllib.request.urlopen(addr + "/v1/namespaces") as resp:
+            assert len(json.loads(resp.read())) == 1
+
+    def test_default_undeletable(self, agent):
+        import urllib.error
+
+        req = urllib.request.Request(
+            agent.rpc_addr + "/v1/namespace/default", method="DELETE"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=15)
+        assert e.value.code == 400
+
+
+class TestAgentObservability:
+    def test_profile_thread_dump(self, agent):
+        with urllib.request.urlopen(
+            agent.rpc_addr + "/v1/agent/profile", timeout=15
+        ) as resp:
+            out = json.loads(resp.read())
+        assert out["Count"] > 3
+        assert any("device-coalescer" in n for n in out["Threads"])
+
+    def test_monitor_streams_logs(self, agent):
+        got = []
+
+        def reader():
+            req = urllib.request.Request(
+                agent.rpc_addr + "/v1/agent/monitor?log_level=warning"
+            )
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        return
+                    rec = json.loads(line)
+                    if rec and "monitor-test" in rec.get("Message", ""):
+                        got.append(rec)
+                        return
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        logging.getLogger("nomad_tpu.test").warning("monitor-test ping")
+        t.join(timeout=20)
+        assert got and got[0]["Level"] == "WARNING"
+
+
+def test_config_file_load_and_merge(tmp_path):
+    from nomad_tpu.api.agent import AgentConfig
+    from nomad_tpu.api.config_file import apply_config, load_config_files
+
+    (tmp_path / "a.hcl").write_text('''
+name       = "from-file"
+datacenter = "dc9"
+server {
+  enabled     = true
+  workers     = 7
+  acl_enabled = true
+  peers       = ["http://h1:1", "http://h2:2"]
+}
+client {
+  enabled = false
+  meta { rack = "r9" }
+}
+''')
+    (tmp_path / "b.hcl").write_text('''
+server { workers = 9 }
+''')
+    doc = load_config_files([str(tmp_path / "a.hcl"), str(tmp_path / "b.hcl")])
+    cfg = AgentConfig()
+    apply_config(doc, cfg)
+    assert cfg.name == "from-file"
+    assert cfg.datacenter == "dc9"
+    assert cfg.server_config.num_workers == 9  # later file wins
+    assert cfg.server_config.acl_enabled is True
+    assert cfg.server_config.peers == ["http://h1:1", "http://h2:2"]
+    assert cfg.client_enabled is False
+    assert cfg.client_config.meta["rack"] == "r9"

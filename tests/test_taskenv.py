@@ -1,0 +1,145 @@
+"""Task env interpolation + artifact/template hooks (VERDICT r3 missing
+item 6: without these 'real workloads can't be expressed').
+
+Reference: client/taskenv/ (NOMAD_* builder + ReplaceEnv),
+task_runner_hooks.go:50-160 (artifact via go-getter, template render).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.taskenv import build_task_env, interpolate, interpolation_map
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.types import AllocClientStatus, Allocation, Task
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(
+        num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+    ))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+class TestEnvBuilder:
+    def test_identity_and_limits(self):
+        job = mock.job()
+        task = job.task_groups[0].tasks[0]
+        alloc = Allocation(
+            job_id=job.id, namespace=job.namespace, job=job,
+            name=f"{job.id}.web[3]", task_group=job.task_groups[0].name,
+            assigned_ports={"group": {"http": 23456}},
+        )
+        env = build_task_env(alloc, task, "/t", "/a")
+        assert env["NOMAD_ALLOC_ID"] == alloc.id
+        assert env["NOMAD_ALLOC_INDEX"] == "3"
+        assert env["NOMAD_JOB_ID"] == job.id
+        assert env["NOMAD_CPU_LIMIT"] == str(int(task.resources.cpu))
+        assert env["NOMAD_PORT_http"] == "23456"
+        assert env["NOMAD_ADDR_http"] == "127.0.0.1:23456"
+        assert env["NOMAD_TASK_DIR"] == "/t"
+
+    def test_interpolation(self):
+        node = mock.node()
+        node.attributes = dict(node.attributes)
+        node.attributes["rack"] = "r7"
+        table = interpolation_map({"NOMAD_JOB_ID": "j1"}, node)
+        assert interpolate("${NOMAD_JOB_ID}-on-${attr.rack}", table) == (
+            "j1-on-r7"
+        )
+        assert interpolate("${node.datacenter}", table) == node.datacenter
+        # Unknown references stay intact (reference behavior).
+        assert interpolate("${mystery.ref}", table) == "${mystery.ref}"
+        assert interpolate(
+            {"k": ["${NOMAD_JOB_ID}"]}, table
+        ) == {"k": ["j1"]}
+
+
+def test_task_sees_nomad_env_end_to_end(server, tmp_path):
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
+    c.start()
+    try:
+        job = mock.job()
+        job.meta = {"owner": "team-a"}
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks = [Task(
+            name="main", driver="raw_exec",
+            config={
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    'echo "$NOMAD_ALLOC_ID|$NOMAD_META_owner|'
+                    '${NOMAD_JOB_ID}" > "$NOMAD_TASK_DIR/out"; sleep 300',
+                ],
+            },
+            env={"WHOAMI": "${NOMAD_TASK_NAME}@${node.datacenter}"},
+        )]
+        for t in tg.tasks:
+            t.resources.cpu = 20
+            t.resources.memory_mb = 32
+        tg.ephemeral_disk.size_mb = 10
+        server.submit_job(job)
+        assert _wait(lambda: [
+            a for a in server.store.allocs_by_job(job.namespace, job.id)
+            if a.client_status == AllocClientStatus.RUNNING.value
+        ], timeout=60)
+        alloc = server.store.allocs_by_job(job.namespace, job.id)[0]
+        out = os.path.join(c.data_dir, alloc.id, "main", "out")
+        assert _wait(lambda: os.path.exists(out), timeout=15)
+        alloc_id, owner, job_id = open(out).read().strip().split("|")
+        assert alloc_id == alloc.id
+        assert owner == "team-a"
+        assert job_id == job.id
+        # Task env values were interpolated too.
+        tr = c.allocs[alloc.id].runners["main"]
+        assert tr.task.env["WHOAMI"] == f"main@{c.node.datacenter}"
+    finally:
+        c.shutdown()
+
+
+def test_artifact_and_template_hooks(server, tmp_path):
+    src = tmp_path / "payload.txt"
+    src.write_text("artifact-content")
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
+    c.start()
+    try:
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks = [Task(
+            name="main", driver="raw_exec",
+            config={"command": "/bin/sleep", "args": ["300"]},
+            artifacts=[{"source": f"file://{src}", "destination": "local"}],
+            templates=[{
+                "data": "alloc=${NOMAD_ALLOC_ID}",
+                "destination": "local/config.ini",
+            }],
+        )]
+        for t in tg.tasks:
+            t.resources.cpu = 20
+            t.resources.memory_mb = 32
+        tg.ephemeral_disk.size_mb = 10
+        server.submit_job(job)
+        assert _wait(lambda: [
+            a for a in server.store.allocs_by_job(job.namespace, job.id)
+            if a.client_status == AllocClientStatus.RUNNING.value
+        ], timeout=60)
+        alloc = server.store.allocs_by_job(job.namespace, job.id)[0]
+        tdir = os.path.join(c.data_dir, alloc.id, "main")
+        assert open(os.path.join(tdir, "local", "payload.txt")).read() == (
+            "artifact-content"
+        )
+        assert open(os.path.join(tdir, "local", "config.ini")).read() == (
+            f"alloc={alloc.id}"
+        )
+    finally:
+        c.shutdown()
