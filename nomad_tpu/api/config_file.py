@@ -85,7 +85,10 @@ def apply_config(doc: Dict, agent_config) -> None:
         sc.num_workers = int(srv.get("workers", sc.num_workers))
         sc.node_capacity = int(srv.get("node_capacity", sc.node_capacity))
         sc.acl_enabled = bool(srv.get("acl_enabled", sc.acl_enabled))
-        sc.server_id = srv.get("server_id", sc.server_id) or ac.name
+        # No name fallback here: CLI flags apply AFTER this, and a shared
+        # config file must not stamp every server with the same
+        # replication identity (Server falls back to its unique address).
+        sc.server_id = srv.get("server_id", sc.server_id)
         peers = srv.get("peers")
         if peers:
             sc.peers = list(peers)

@@ -211,6 +211,15 @@ class HTTPAPIServer:
                 403, f"Permission denied ({cap} on {namespace!r})"
             )
 
+    def _require_management(self, server, token: str) -> None:
+        """Cluster-wide mutations (namespaces) need a management token
+        (namespace_endpoint.go requires one for upsert/delete)."""
+        if not server.config.acl_enabled:
+            return
+        acl = server.resolve_token(token)
+        if acl is None or not acl.management:
+            raise HTTPError(403, "Permission denied (management only)")
+
     def _check_acl(
         self, server, method: str, path: str, query: Dict, token: str
     ) -> None:
@@ -343,9 +352,22 @@ class HTTPAPIServer:
         import queue as _queue
 
         server = self.agent.server
-        if server is not None and server.config.acl_enabled:
-            acl = server.resolve_token(token)
-            if acl is None or not acl.allow_agent("read"):
+        if server is not None:
+            if server.config.acl_enabled:
+                acl = server.resolve_token(token)
+                if acl is None or not acl.allow_agent("read"):
+                    raise HTTPError(403, "Permission denied (agent:read)")
+        elif self.agent.client is not None:
+            # Client-only agent: forward the check to the server — direct
+            # node access must not bypass ACLs (same invariant as the fs
+            # surface below).
+            try:
+                allowed = self.agent.client.server.check_acl_capability(
+                    token, "agent", "read"
+                )
+            except Exception as exc:  # noqa: BLE001 — fail closed
+                raise HTTPError(502, f"ACL check unavailable: {exc}")
+            if not allowed:
                 raise HTTPError(403, "Permission denied (agent:read)")
 
         level = getattr(
@@ -366,7 +388,17 @@ class HTTPAPIServer:
                     pass  # slow consumer: drop, never block the logger
 
         tap = _Tap(level=level)
-        logging.getLogger().addHandler(tap)
+        root = logging.getLogger()
+        root.addHandler(tap)
+        # The handler level alone can't see records the root logger drops:
+        # with no logging config, the effective level is WARNING and an
+        # info/debug monitor would stream nothing.  Lower the root level
+        # for the stream's lifetime (the reference's monitor sink does the
+        # same); restored below.  Concurrent monitors at different levels
+        # keep the lowest until the last one exits — benign over-logging.
+        prev_level = root.level
+        if level < (root.level or logging.WARNING):
+            root.setLevel(level)
         try:
             handler.send_response(200)
             handler.send_header("Content-Type", "application/x-ndjson")
@@ -382,7 +414,8 @@ class HTTPAPIServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
-            logging.getLogger().removeHandler(tap)
+            root.removeHandler(tap)
+            root.setLevel(prev_level)
 
     # ------------------------------------------------------------------
     # Task filesystem + logs (reference: command/agent/fs_endpoint.go
@@ -663,10 +696,11 @@ class HTTPAPIServer:
 
         if path == "/v1/jobs" and method == "GET":
             prefix = query.get("prefix", "")
+            ns = query.get("namespace", "default")
             return [
                 self._job_stub(j)
                 for j in store.all_jobs()
-                if j.id.startswith(prefix)
+                if j.id.startswith(prefix) and j.namespace == ns
             ]
         if path == "/v1/jobs" and method in ("PUT", "POST"):
             payload = (body or {}).get("Job", body)
@@ -785,6 +819,12 @@ class HTTPAPIServer:
             return _dump(ev)
         m = re.match(r"^/v1/evaluation/([^/]+)/allocations$", path)
         if m and method == "GET":
+            ev = store.eval_by_id(m.group(1))
+            if ev is None:
+                raise HTTPError(404, "eval not found")
+            from ..acl import CAP_READ_JOB
+
+            self._require_ns_cap(server, token, ev.namespace, CAP_READ_JOB)
             return _dump(store.allocs_by_eval(m.group(1)), exclude=("job",))
 
         if path == "/v1/allocations" and method == "GET":
@@ -898,12 +938,14 @@ class HTTPAPIServer:
                     raise HTTPError(404, "namespace not found")
                 return ns_obj
             if method in ("PUT", "POST"):
+                self._require_management(server, token)
                 store.upsert_namespace(
                     server.next_index(), m.group(1),
                     (body or {}).get("Description", ""),
                 )
                 return {}
             if method == "DELETE":
+                self._require_management(server, token)
                 try:
                     store.delete_namespace(server.next_index(), m.group(1))
                 except ValueError as exc:

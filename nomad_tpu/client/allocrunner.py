@@ -36,11 +36,15 @@ class AllocRunner:
         data_dir: str,
         on_alloc_update: Callable[["AllocRunner"], None],
         node=None,
+        wait_for_prev_terminal: Optional[Callable[[str, float], bool]] = None,
     ):
         self.alloc = alloc
         self.drivers = drivers
         self.on_alloc_update = on_alloc_update
         self.node = node  # for ${attr.*}/${node.*} interpolation
+        # Gate for disk migration: blocks until the replaced alloc stops
+        # writing (client/allocwatcher prevAllocWatcher.Wait).
+        self.wait_for_prev_terminal = wait_for_prev_terminal
         self.alloc_dir = os.path.join(data_dir, alloc.id)
         self.client_status = AllocClientStatus.PENDING.value
         self.task_states: Dict[str, TaskState] = {}
@@ -72,6 +76,7 @@ class AllocRunner:
     def _run(self) -> None:
         # Alloc-dir hook: shared + per-task dirs (client/allocdir layout).
         os.makedirs(os.path.join(self.alloc_dir, "alloc"), exist_ok=True)
+        self._migrate_previous_disk()
 
         tasks = self._tasks()
         if not tasks:
@@ -139,6 +144,61 @@ class AllocRunner:
             if not self._destroyed:
                 launch(t).wait()
         self._finalize()
+
+    def _migrate_previous_disk(self) -> None:
+        """Ephemeral-disk sticky/migrate data movement (the
+        client/allocwatcher/ + prevAllocMigrator seam, trimmed to the
+        same-agent case): when the replaced alloc's dir is still on this
+        agent, carry its shared ``alloc/`` dir and each task's ``local/``
+        dir into the new alloc.  The scheduler's sticky preference
+        (findPreferredNode) makes same-node the common case; cross-node
+        migration (the reference streams via the FS API) is not attempted.
+        """
+        import shutil
+
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        disk = tg.ephemeral_disk if tg else None
+        if not self.alloc.previous_allocation or disk is None or not (
+            disk.sticky or disk.migrate
+        ):
+            return
+        prev_dir = os.path.join(
+            os.path.dirname(self.alloc_dir), self.alloc.previous_allocation
+        )
+        if not os.path.isdir(prev_dir):
+            return  # previous alloc lived on another node
+        # Copying while the old task still writes would inherit torn data:
+        # wait for the replaced alloc to reach a terminal state first
+        # (prevAllocWatcher.Wait semantics).
+        if self.wait_for_prev_terminal is not None:
+            if not self.wait_for_prev_terminal(
+                self.alloc.previous_allocation, 60.0
+            ):
+                log.warning(
+                    "previous alloc %s not terminal after 60s; skipping "
+                    "disk migration", self.alloc.previous_allocation[:8],
+                )
+                return
+        moved = []
+        for rel in ["alloc"] + [
+            os.path.join(t.name, "local") for t in (tg.tasks if tg else [])
+        ]:
+            src = os.path.join(prev_dir, rel)
+            dst = os.path.join(self.alloc_dir, rel)
+            if not os.path.isdir(src):
+                continue
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            try:
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+                moved.append(rel)
+            except OSError:
+                log.exception("disk migration of %s failed", rel)
+        if moved:
+            log.info(
+                "alloc %s inherited %s from %s",
+                self.alloc.id[:8], moved, self.alloc.previous_allocation[:8],
+            )
 
     # ------------------------------------------------------------------
 

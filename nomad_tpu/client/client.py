@@ -155,6 +155,7 @@ class Client:
             ar = AllocRunner(
                 alloc, self.drivers, self.data_dir, self._alloc_updated,
                 node=self.node,
+                wait_for_prev_terminal=self._wait_prev_terminal,
             )
             with self._lock:
                 self.allocs[alloc.id] = ar
@@ -225,6 +226,7 @@ class Client:
                 ar = AllocRunner(
                     alloc, self.drivers, self.data_dir, self._alloc_updated,
                     node=self.node,
+                    wait_for_prev_terminal=self._wait_prev_terminal,
                 )
                 with self._lock:
                     self.allocs[aid] = ar
@@ -234,6 +236,18 @@ class Client:
                 self._persist(ar)
 
         self._gc_terminal_allocs()
+
+    def _wait_prev_terminal(self, alloc_id: str, timeout: float) -> bool:
+        """Block until the (local) replaced alloc stops running so disk
+        migration never copies from a live writer (allocwatcher.Wait)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                ar = self.allocs.get(alloc_id)
+            if ar is None or ar.terminal:
+                return True
+            time.sleep(0.1)
+        return False
 
     def _gc_terminal_allocs(self) -> None:
         """Evict the oldest terminal AllocRunners past the budget so

@@ -205,7 +205,14 @@ class TaskRunner:
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         data = tpl.get("data")
         if data is None and tpl.get("source"):
-            with open(str(tpl["source"])) as fh:
+            # Sources resolve against (and are sandboxed to) the task dir
+            # — an arbitrary host path here would let a job exfiltrate any
+            # agent-readable file (the reference requires
+            # disable_file_sandbox to read outside the task dir).
+            src_path = os.path.join(self.task_dir, str(tpl["source"]))
+            if not self._inside_task_dir(src_path):
+                raise ValueError("template source escapes task dir")
+            with open(src_path) as fh:
                 data = fh.read()
         with open(dest, "w") as fh:
             fh.write(str(data or ""))

@@ -220,13 +220,34 @@ class GenericScheduler:
 
         for tg_name, reqs in by_tg.items():
             tg = reqs[0].task_group
-            plain = [p for p in reqs if not _penalty_nodes(p)]
-            penalized = [p for p in reqs if _penalty_nodes(p)]
+            sticky = (
+                tg.ephemeral_disk.sticky if tg.ephemeral_disk else False
+            )
+            plain, penalized, preferred = [], [], []
+            for p in reqs:
+                if _penalty_nodes(p):
+                    penalized.append(p)
+                elif sticky and p.previous_alloc is not None:
+                    preferred.append(p)
+                else:
+                    plain.append(p)
 
             if plain:
                 options = stack.select(tg, n_placements=len(plain))
                 for p, opt in zip(plain, options):
                     self._handle_option(ctx, job, eval, p, opt, tg)
+            for p in preferred:
+                # Sticky ephemeral disk: try the previous alloc's node
+                # FIRST so local data survives the replacement; fall back
+                # to a normal placement (findPreferredNode,
+                # generic_sched.go:756-770).
+                opts = stack.select(
+                    tg, n_placements=1,
+                    restrict_nodes=[p.previous_alloc.node_id],
+                )
+                if opts[0] is None:
+                    opts = stack.select(tg, n_placements=1)
+                self._handle_option(ctx, job, eval, p, opts[0], tg)
             for p in penalized:
                 opts = stack.select(
                     tg, n_placements=1, penalty_nodes=_penalty_nodes(p)

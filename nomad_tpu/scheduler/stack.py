@@ -407,19 +407,26 @@ class GenericStack:
         tg: TaskGroup,
         n_placements: int = 1,
         penalty_nodes: Optional[Sequence[str]] = None,
+        restrict_nodes: Optional[Sequence[str]] = None,
     ) -> List[Optional[SelectionOption]]:
         """Place ``n_placements`` allocs of ``tg``; one option (or None) per
         requested placement (reference: stack.go:117-179 Select, called per
-        missing alloc from generic_sched.go:472).
+        missing alloc from generic_sched.go:472).  ``restrict_nodes`` limits
+        candidates to the given set (sticky ephemeral-disk preference,
+        generic_sched.go:756-770 findPreferredNode).
 
         With a coalescer attached to the matrix (the live server), the
         kernel call is batched with other workers' selects and this method
         never touches the device directly; otherwise the whole selection
         holds DEVICE_LOCK (tests, solo tools)."""
         if getattr(self.matrix, "coalescer", None) is not None:
-            return self._select_locked(tg, n_placements, penalty_nodes)
+            return self._select_locked(
+                tg, n_placements, penalty_nodes, restrict_nodes
+            )
         with DEVICE_LOCK:
-            return self._select_locked(tg, n_placements, penalty_nodes)
+            return self._select_locked(
+                tg, n_placements, penalty_nodes, restrict_nodes
+            )
 
     # -- kernel dispatch (coalesced or solo) --------------------------------
 
@@ -502,6 +509,7 @@ class GenericStack:
         tg: TaskGroup,
         n_placements: int = 1,
         penalty_nodes: Optional[Sequence[str]] = None,
+        restrict_nodes: Optional[Sequence[str]] = None,
     ) -> List[Optional[SelectionOption]]:
         assert self.job is not None, "set_job first"
         job = self.job
@@ -526,6 +534,16 @@ class GenericStack:
         class_elig = self._class_eligibility(compiled)
         base_host_mask = self._host_mask(job, tg, compiled)
         self._record_eligibility(class_elig, base_host_mask)
+        if restrict_nodes is not None:
+            allowed = np.zeros((n,), bool)
+            for node_id in restrict_nodes:
+                row = self.matrix.row_of.get(node_id)
+                if row is not None:
+                    allowed[row] = True
+            base_host_mask = (
+                allowed if base_host_mask is None
+                else (base_host_mask & allowed)
+            )
 
         options: List[Optional[SelectionOption]] = []
         banned_rows: List[int] = []

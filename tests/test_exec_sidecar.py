@@ -61,6 +61,16 @@ def _sidecar_pid(client) -> int:
     return int(out["pid"])
 
 
+def _dead_or_zombie(pid: int) -> bool:
+    """A SIGKILLed child stays visible in /proc as a zombie until reaped —
+    'gone' means no process OR state Z."""
+    try:
+        with open(f"/proc/{pid}/stat") as fh:
+            return fh.read().split(")")[-1].split()[0] == "Z"
+    except OSError:
+        return True
+
+
 def test_exec_task_runs_in_own_session(server, tmp_path):
     c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
     c.start()
@@ -122,10 +132,10 @@ def test_sidecar_kill9_task_survives_and_recovers(server, tmp_path):
         old_sidecar = _sidecar_pid(c)
 
         os.kill(old_sidecar, signal.SIGKILL)
-        time.sleep(0.3)
-        assert not os.path.exists(f"/proc/{old_sidecar}")
+        assert _wait(lambda: _dead_or_zombie(old_sidecar), timeout=10)
         # The task survived the supervisor's death (setsid + detach).
         assert os.path.exists(f"/proc/{task_pid}")
+        assert not _dead_or_zombie(task_pid)
 
         # The driver's next op transparently respawns + recovers.
         sc = c.drivers.get("exec")._sidecar
@@ -141,7 +151,7 @@ def test_sidecar_kill9_task_survives_and_recovers(server, tmp_path):
         ar = c.allocs[alloc.id]
         assert _wait(
             lambda: ar.task_states["main"].restarts > 0 or ar.terminal,
-            timeout=30,
+            timeout=60,
         )
     finally:
         c.shutdown()

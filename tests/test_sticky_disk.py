@@ -1,0 +1,116 @@
+"""Ephemeral-disk sticky/migrate (VERDICT r3 missing item 7).
+
+Reference: findPreferredNode (scheduler/generic_sched.go:756-770) places
+sticky replacements on the previous alloc's node; the prev-alloc watcher
+(client/allocwatcher/) carries the disk data into the new alloc dir.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from helpers import _client, _small, _wait
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.types import AllocClientStatus, Task
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(
+        num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+    ))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _sticky_job(marker: str):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.ephemeral_disk.sticky = True
+    tg.ephemeral_disk.migrate = True
+    tg.ephemeral_disk.size_mb = 10
+    tg.tasks = [Task(
+        name="main", driver="raw_exec",
+        config={
+            "command": "/bin/sh",
+            "args": [
+                "-c",
+                f'echo {marker} >> "$NOMAD_TASK_DIR/local/state.txt"; '
+                "sleep 300",
+            ],
+        },
+    )]
+    for t in tg.tasks:
+        t.resources.cpu = 20
+        t.resources.memory_mb = 32
+    return job
+
+
+def _running(server, job, version=None, n=2, timeout=60):
+    def ready():
+        allocs = [
+            a for a in server.store.allocs_by_job(job.namespace, job.id)
+            if a.client_status == AllocClientStatus.RUNNING.value
+            and (version is None
+                 or (a.job is not None and a.job.version == version))
+        ]
+        return allocs if len(allocs) == n else None
+    assert _wait(lambda: ready() is not None, timeout=timeout)
+    return ready()
+
+
+def test_sticky_replacement_stays_on_node_and_keeps_data(server, tmp_path):
+    c1 = _client(server, tmp_path, "c1")
+    c2 = _client(server, tmp_path, "c2")
+    try:
+        job = _sticky_job("v0")
+        ev = server.submit_job(job)
+        server.wait_for_eval(ev.id, timeout=90)
+        originals = _running(server, job, version=0)
+        node_of = {a.id: a.node_id for a in originals}
+
+        # Destructive update → replacements.
+        job2 = job.copy()
+        job2.task_groups = [job2.task_groups[0]]
+        job2.task_groups[0].tasks[0].env = {"V": "2"}
+        ev2 = server.submit_job(job2)
+        server.wait_for_eval(ev2.id, timeout=90)
+        replacements = _running(server, job, version=1)
+
+        for a in replacements:
+            assert a.previous_allocation in node_of
+            # Sticky: same node as the alloc it replaced.
+            assert a.node_id == node_of[a.previous_allocation], (
+                a.node_id, node_of[a.previous_allocation]
+            )
+            # Migrate: the previous alloc's local data came along.
+            client = c1 if a.node_id == c1.node.id else c2
+            state = os.path.join(
+                client.data_dir, a.id, "main", "local", "state.txt"
+            )
+            assert _wait(lambda s=state: os.path.exists(s), timeout=15)
+            content = open(state).read()
+            assert "v0" in content, content  # inherited from predecessor
+    finally:
+        c1.shutdown()
+        c2.shutdown()
+
+
+def test_non_sticky_placement_unrestricted(server, tmp_path):
+    """Control: without sticky, replacements place wherever binpack says
+    (no restriction failure either way — just no crash and full count)."""
+    c1 = _client(server, tmp_path, "c1")
+    try:
+        job = _sticky_job("x")
+        job.task_groups[0].ephemeral_disk.sticky = False
+        job.task_groups[0].ephemeral_disk.migrate = False
+        ev = server.submit_job(job)
+        server.wait_for_eval(ev.id, timeout=90)
+        assert _running(server, job, version=0)
+    finally:
+        c1.shutdown()
