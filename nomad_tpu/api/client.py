@@ -85,6 +85,26 @@ class APIClient:
     def acl_token_self(self) -> Dict:
         return self._call("GET", "/v1/acl/token/self")
 
+    # Namespaces + search ----------------------------------------------
+
+    def list_namespaces(self) -> List[Dict]:
+        return self._call("GET", "/v1/namespaces")
+
+    def upsert_namespace(self, name: str, description: str = "") -> Dict:
+        return self._call(
+            "PUT", f"/v1/namespace/{name}", {"Description": description}
+        )
+
+    def delete_namespace(self, name: str) -> Dict:
+        return self._call("DELETE", f"/v1/namespace/{name}")
+
+    def search(
+        self, prefix: str, context: str = "all", namespace: str = "default"
+    ) -> Dict:
+        return self._call("POST", "/v1/search", {
+            "Prefix": prefix, "Context": context, "Namespace": namespace,
+        })
+
     def get_job(self, job_id: str, namespace: str = "default") -> Dict:
         return self._call("GET", f"/v1/job/{job_id}?namespace={namespace}")
 

@@ -312,6 +312,67 @@ def cmd_alloc_fs(args) -> int:
     return 1
 
 
+def cmd_acl(args) -> int:
+    """ACL admin (reference: `nomad acl bootstrap/policy/token`)."""
+    client = _client(args)
+    if args.acl_cmd == "bootstrap":
+        t = client.acl_bootstrap()
+        print(f"Accessor ID = {t['accessor_id']}")
+        print(f"Secret ID   = {t['secret_id']}")
+        print(f"Type        = {t['type']}")
+        return 0
+    if args.acl_cmd == "policy-apply":
+        client.acl_upsert_policy(
+            args.name, open(args.rules_file).read(),
+            description=args.description,
+        )
+        print(f"Policy {args.name!r} applied")
+        return 0
+    if args.acl_cmd == "token-create":
+        t = client.acl_create_token(
+            name=args.name, type=args.type,
+            policies=args.policy or [],
+        )
+        print(f"Accessor ID = {t['accessor_id']}")
+        print(f"Secret ID   = {t['secret_id']}")
+        print(f"Policies    = {t['policies']}")
+        return 0
+    return 1
+
+
+def cmd_namespace(args) -> int:
+    client = _client(args)
+    if args.ns_cmd == "list":
+        for n in client.list_namespaces():
+            print(f"{n['Name']:20} {n.get('Description', '')}")
+        return 0
+    if args.ns_cmd == "apply":
+        client.upsert_namespace(args.name, description=args.description)
+        print(f"Namespace {args.name!r} applied")
+        return 0
+    if args.ns_cmd == "delete":
+        client.delete_namespace(args.name)
+        print(f"Namespace {args.name!r} deleted")
+        return 0
+    return 1
+
+
+def cmd_search(args) -> int:
+    client = _client(args)
+    out = client.search(
+        args.prefix, context=args.context, namespace=args.namespace
+    )
+    for context, ids in sorted(out.get("Matches", {}).items()):
+        if not ids:
+            continue
+        print(f"{context}:")
+        for i in ids:
+            print(f"  {i}")
+        if out.get("Truncations", {}).get(context):
+            print("  ... (truncated)")
+    return 0
+
+
 def cmd_eval_status(args) -> int:
     client = _client(args)
     _print(client.get_evaluation(args.eval_id))
@@ -428,6 +489,42 @@ def build_parser() -> argparse.ArgumentParser:
     afs.add_argument("alloc_id")
     afs.add_argument("path", nargs="?", default="")
     afs.set_defaults(fn=cmd_alloc_fs)
+
+    acl = sub.add_parser("acl", help="ACL admin").add_subparsers(
+        dest="acl_cmd", required=True
+    )
+    acl.add_parser("bootstrap").set_defaults(fn=cmd_acl)
+    pol = acl.add_parser("policy-apply")
+    pol.add_argument("name")
+    pol.add_argument("rules_file")
+    pol.add_argument("-description", default="")
+    pol.set_defaults(fn=cmd_acl)
+    tok = acl.add_parser("token-create")
+    tok.add_argument("-name", default="")
+    tok.add_argument("-type", default="client")
+    tok.add_argument("-policy", action="append")
+    tok.set_defaults(fn=cmd_acl)
+
+    ns = sub.add_parser("namespace", help="namespace ops").add_subparsers(
+        dest="ns_cmd", required=True
+    )
+    ns.add_parser("list").set_defaults(fn=cmd_namespace)
+    nsap = ns.add_parser("apply")
+    nsap.add_argument("name")
+    nsap.add_argument("-description", default="")
+    nsap.set_defaults(fn=cmd_namespace)
+    nsdel = ns.add_parser("delete")
+    nsdel.add_argument("name")
+    nsdel.set_defaults(fn=cmd_namespace)
+
+    search = sub.add_parser("search", help="prefix search")
+    search.add_argument("prefix")
+    search.add_argument(
+        "-context", default="all",
+        choices=["all", "jobs", "nodes", "allocs", "evals", "deployment"],
+    )
+    search.add_argument("-namespace", default="default")
+    search.set_defaults(fn=cmd_search)
 
     ev = sub.add_parser("eval", help="evaluation ops").add_subparsers(
         dest="eval_cmd", required=True

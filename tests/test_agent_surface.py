@@ -154,3 +154,60 @@ server { workers = 9 }
     assert cfg.server_config.peers == ["http://h1:1", "http://h2:2"]
     assert cfg.client_enabled is False
     assert cfg.client_config.meta["rack"] == "r9"
+
+
+def test_cli_acl_namespace_search(tmp_path):
+    """CLI surface for ACLs, namespaces, and search against a live agent."""
+    import subprocess
+    import sys
+
+    from nomad_tpu.api import Agent, AgentConfig
+    from nomad_tpu.server import ServerConfig
+
+    a = Agent(AgentConfig(
+        client_enabled=False,
+        server_config=ServerConfig(
+            num_workers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=90,
+            acl_enabled=True,
+        ),
+    ))
+    a.start()
+    try:
+        def cli(*args, token=""):
+            cmd = [sys.executable, "-m", "nomad_tpu.cli",
+                   "--address", a.rpc_addr]
+            if token:
+                cmd += ["--token", token]
+            import os
+
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            return subprocess.run(
+                cmd + list(args), capture_output=True, text=True,
+                timeout=60, cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+
+        out = cli("acl", "bootstrap")
+        assert "Secret ID" in out.stdout, out.stdout + out.stderr
+        secret = next(
+            l.split("=")[1].strip() for l in out.stdout.splitlines()
+            if l.startswith("Secret ID")
+        )
+        rules = tmp_path / "p.hcl"
+        rules.write_text('namespace "default" { policy = "write" }')
+        out = cli("acl", "policy-apply", "writer", str(rules), token=secret)
+        assert "applied" in out.stdout, out.stdout + out.stderr
+        out = cli("acl", "token-create", "-name", "ci",
+                  "-policy", "writer", token=secret)
+        assert "Secret ID" in out.stdout
+
+        out = cli("namespace", "apply", "prod", token=secret)
+        assert "applied" in out.stdout
+        out = cli("namespace", "list", token=secret)
+        assert "prod" in out.stdout and "default" in out.stdout
+
+        a.server.submit_job(mock.job(id="searchable-job"))
+        out = cli("search", "searchable", token=secret)
+        assert "searchable-job" in out.stdout, out.stdout + out.stderr
+    finally:
+        a.shutdown()
