@@ -102,7 +102,10 @@ class HTTPAPIServer:
                         "X-Nomad-Token", query.get("token", "")
                     )
                     result = api.route(
-                        method, parsed.path, query, body, token=token
+                        method, parsed.path, query, body, token=token,
+                        cluster_secret=self.headers.get(
+                            "X-Nomad-Cluster-Secret", ""
+                        ),
                     )
                     self._respond(200, result)
                 except HTTPError as exc:
@@ -612,7 +615,7 @@ class HTTPAPIServer:
 
     def route(
         self, method: str, path: str, query: Dict, body: Any,
-        token: str = "",
+        token: str = "", cluster_secret: str = "",
     ) -> Any:
         server = self.agent.server
         if server is None:
@@ -624,6 +627,24 @@ class HTTPAPIServer:
             rep = store.replicator
             if rep is None:
                 raise HTTPError(501, "server is not running replication")
+            # Peer authentication: an unauthenticated snapshot-install
+            # would let any caller replace the whole cluster state.  A
+            # configured cluster_secret must match; with ACLs on and no
+            # secret, a management token is accepted instead.
+            want = server.config.cluster_secret
+            if want:
+                import hmac
+
+                if not hmac.compare_digest(cluster_secret, want):
+                    raise HTTPError(403, "bad or missing cluster secret")
+            elif server.config.acl_enabled:
+                acl = server.resolve_token(token)
+                if acl is None or not acl.management:
+                    raise HTTPError(
+                        403,
+                        "raft RPCs require a cluster_secret or a "
+                        "management token",
+                    )
             if path == "/v1/internal/raft/append":
                 return rep.handle_append(body or {})
             if path == "/v1/internal/raft/vote":

@@ -37,11 +37,13 @@ class AllocRunner:
         on_alloc_update: Callable[["AllocRunner"], None],
         node=None,
         wait_for_prev_terminal: Optional[Callable[[str, float], bool]] = None,
+        artifact_root: str = "",
     ):
         self.alloc = alloc
         self.drivers = drivers
         self.on_alloc_update = on_alloc_update
-        self.node = node  # for ${attr.*}/${node.*} interpolation
+        self.node = node
+        self.artifact_root = artifact_root  # for ${attr.*}/${node.*} interpolation
         # Gate for disk migration: blocks until the replaced alloc stops
         # writing (client/allocwatcher prevAllocWatcher.Wait).
         self.wait_for_prev_terminal = wait_for_prev_terminal
@@ -111,6 +113,7 @@ class AllocRunner:
                 task_dir=task_dir,
                 restart_policy=restart or tg.restart_policy,
                 on_state_change=self._on_task_state,
+                artifact_root=self.artifact_root,
             )
             with self._lock:
                 self.runners[task.name] = tr
@@ -274,6 +277,7 @@ class AllocRunner:
                     task_dir=task_dir,
                     restart_policy=restart,
                     on_state_change=self._on_task_state,
+                    artifact_root=self.artifact_root,
                 )
                 with self._lock:
                     self.runners[task.name] = tr

@@ -57,6 +57,9 @@ class ClientConfig:
     # Client GC (client/gc.go): keep at most this many terminal alloc dirs;
     # the oldest are evicted (runner destroyed, dir removed, state dropped).
     max_terminal_allocs: int = 50
+    # Host path local (file://) artifact sources may read from; empty =
+    # file sources restricted to the task dir (exfiltration sandbox).
+    artifact_root: str = ""
 
 
 class Client:
@@ -156,6 +159,7 @@ class Client:
                 alloc, self.drivers, self.data_dir, self._alloc_updated,
                 node=self.node,
                 wait_for_prev_terminal=self._wait_prev_terminal,
+                artifact_root=self.config.artifact_root,
             )
             with self._lock:
                 self.allocs[alloc.id] = ar

@@ -43,6 +43,7 @@ class TaskRunner:
         task_dir: str,
         restart_policy: RestartPolicy,
         on_state_change: Callable[[str, TaskState], None],
+        artifact_root: str = "",
     ):
         self.alloc_id = alloc_id
         self.task = task
@@ -50,6 +51,10 @@ class TaskRunner:
         self.task_dir = task_dir
         self.restart_policy = restart_policy
         self.on_state_change = on_state_change
+        # Operator-configured root that local (file://) artifact sources may
+        # be fetched from; empty = local sources restricted to the task dir
+        # (the reference sandboxes go-getter file fetches the same way).
+        self.artifact_root = artifact_root
 
         self.state = TaskState()
         self.handle: Optional[TaskHandle] = None
@@ -182,7 +187,23 @@ class TaskRunner:
         name = os.path.basename(parsed.path) or "artifact"
         target = os.path.join(dest_dir, name)
         if parsed.scheme in ("", "file"):
-            shutil.copy(parsed.path, target)
+            # Sandbox the SOURCE too: without this, any submit-job token
+            # could read arbitrary agent-readable host files (e.g. the
+            # server's WAL, which journals ACL secrets) into its task dir
+            # and exfiltrate them through the alloc fs API.  Local sources
+            # must live inside the task dir or the operator-allowlisted
+            # artifact root.
+            src = os.path.realpath(parsed.path)
+            allowed = self._inside_task_dir(src)
+            if not allowed and self.artifact_root:
+                root = os.path.realpath(self.artifact_root)
+                allowed = src == root or src.startswith(root + os.sep)
+            if not allowed:
+                raise ValueError(
+                    "file artifact source escapes task dir (set the "
+                    "client's artifact_root to allowlist a host path)"
+                )
+            shutil.copy(src, target)
         elif parsed.scheme in ("http", "https"):
             with urllib.request.urlopen(source, timeout=60) as resp, open(
                 target, "wb"

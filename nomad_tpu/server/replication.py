@@ -34,15 +34,26 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import random
 import threading
 import time
 import urllib.error
 import urllib.request
-from dataclasses import dataclass, field
+from collections import OrderedDict
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 log = logging.getLogger(__name__)
+
+# Operators (and the test suite) can widen every raft timer under CPU
+# contention: timeouts of 0.25-0.5s with 80ms heartbeats flap when a loaded
+# machine delays scheduler threads past the election window.
+TIMEOUT_SCALE = float(os.environ.get("NOMAD_TPU_RAFT_TIMEOUT_SCALE", "1.0"))
+
+# Recent entries retained in memory for follower catch-up by re-send
+# (log repair) instead of full-snapshot install.
+LOG_RING_CAPACITY = 4096
 
 
 class NotLeaderError(Exception):
@@ -87,6 +98,8 @@ class Replicator:
         rpc_timeout: float = 5.0,
         append_timeout: float = 1.5,
         peer_cooldown: float = 0.5,
+        cluster_secret: str = "",
+        state_dir: Optional[str] = None,
     ):
         self.server = server
         self.id = server_id
@@ -94,11 +107,18 @@ class Replicator:
         self.peers: Dict[str, PeerState] = {
             a: PeerState(addr=a) for a in peer_addrs if a and a != self_addr
         }
-        self.election_timeout = election_timeout
-        self.heartbeat_interval = heartbeat_interval
+        s = TIMEOUT_SCALE
+        self.election_timeout = (election_timeout[0] * s,
+                                 election_timeout[1] * s)
+        self.heartbeat_interval = heartbeat_interval * s
         self.rpc_timeout = rpc_timeout
         self.append_timeout = append_timeout
         self.peer_cooldown = peer_cooldown
+        # Shared secret authenticating server↔server raft RPCs (an
+        # unauthenticated /v1/internal/raft/snapshot could otherwise replace
+        # the whole cluster state).  Sent on every peer RPC; checked by the
+        # HTTP layer before routing to the handlers below.
+        self.cluster_secret = cluster_secret
 
         self._lock = threading.RLock()
         # Serializes follower-side stream application (append/snapshot).
@@ -111,12 +131,25 @@ class Replicator:
         self.role = self.FOLLOWER
         self.term = 0
         self.voted_for: Optional[str] = None
+        # Hard state (term, voted_for) persists across restarts (raft §5.1:
+        # a server that re-votes in a term it already voted in can elect two
+        # leaders).  None = diskless (tests/sim) — memory only.
+        self._state_path = (
+            os.path.join(state_dir, "raft_state.json") if state_dir else None
+        )
+        self._load_hard_state()
         self.leader_id: Optional[str] = None
         self.leader_addr: str = ""
         # Log position: mirrors the WAL sequence (authoritative when a WAL
         # is attached; tracked here for diskless test servers).
         wal = server.store.wal
         self.last_seq = wal.seq if wal is not None else 0
+        # Recent entries by seq, for catch-up by re-send: a follower that
+        # is merely behind gets the missing suffix re-shipped instead of a
+        # full snapshot install (hashicorp/raft's pipeline replication
+        # repairs the same way; snapshots only when the log has been
+        # compacted past the follower's position).
+        self._log_ring: "OrderedDict[int, Dict]" = OrderedDict()
         self._last_heartbeat = time.monotonic()
 
         self._stop = threading.Event()
@@ -153,6 +186,51 @@ class Replicator:
             raise NotLeaderError(self.leader_addr)
 
     # ------------------------------------------------------------------
+    # Hard state (raft §5.1: currentTerm + votedFor survive restarts)
+    # ------------------------------------------------------------------
+
+    def _load_hard_state(self) -> None:
+        if self._state_path and os.path.exists(self._state_path):
+            try:
+                with open(self._state_path) as fh:
+                    st = json.load(fh)
+                self.term = int(st.get("term", 0))
+                self.voted_for = st.get("voted_for") or None
+                return
+            except (OSError, ValueError) as exc:
+                log.warning("raft hard state unreadable: %s", exc)
+
+    def _persist_hard_state_locked(self) -> None:
+        """Write (term, voted_for) durably BEFORE acting on them — a vote
+        response must not be sent until the vote cannot be forgotten."""
+        if not self._state_path:
+            return
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._state_path)
+
+    # ------------------------------------------------------------------
+    # Log ring (catch-up by re-send instead of snapshot install)
+    # ------------------------------------------------------------------
+
+    def _ring_add_locked(self, entry: Dict) -> None:
+        self._log_ring[entry["s"]] = entry
+        while len(self._log_ring) > LOG_RING_CAPACITY:
+            self._log_ring.popitem(last=False)
+
+    def _ring_suffix(self, from_seq: int) -> Optional[List[Dict]]:
+        """Entries (from_seq, last_seq], or None if the ring has been
+        compacted past from_seq (then only a snapshot can repair)."""
+        with self._lock:
+            want = range(from_seq + 1, self.last_seq + 1)
+            if not all(s in self._log_ring for s in want):
+                return None
+            return [self._log_ring[s] for s in want]
+
+    # ------------------------------------------------------------------
     # Peer RPC plumbing (HTTP; the same wire the agents already speak)
     # ------------------------------------------------------------------
 
@@ -161,9 +239,11 @@ class Replicator:
         timeout: Optional[float] = None,
     ) -> Dict:
         data = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.cluster_secret:
+            headers["X-Nomad-Cluster-Secret"] = self.cluster_secret
         req = urllib.request.Request(
-            addr + path, data=data, method="POST",
-            headers={"Content-Type": "application/json"},
+            addr + path, data=data, method="POST", headers=headers,
         )
         with urllib.request.urlopen(
             req, timeout=timeout or self.rpc_timeout
@@ -187,6 +267,7 @@ class Replicator:
         if not self.peers:
             with self._lock:
                 self.last_seq = entry["s"]
+                self._ring_add_locked(entry)
             return
         acks = 1  # self
         needed = self.quorum()
@@ -224,6 +305,7 @@ class Replicator:
             )
         with self._lock:
             self.last_seq = entry["s"]
+            self._ring_add_locked(entry)
 
     def _send_entries(
         self, peer: PeerState, term: int, prev_seq: int, entries: List[Dict],
@@ -246,9 +328,45 @@ class Replicator:
             self._observe_term(out["Term"])
             return False
         if out.get("NeedSnapshot"):
-            # The write path must NOT install inline: its caller holds the
-            # store lock, and to_snapshot_wire would self-deadlock across
-            # threads (and stall every write behind a full state transfer).
+            # Log repair first: if the follower is merely BEHIND (its seq
+            # is a prefix of ours still in the ring), re-send the missing
+            # suffix — far cheaper than a snapshot install, and the only
+            # path a healthy-but-slow follower should ever take.  A
+            # diverged follower (ahead of us, or compacted past) still
+            # needs the full FSM image.
+            peer_seq = int(out.get("Seq", -1))
+            with self._lock:
+                behind = 0 <= peer_seq < self.last_seq
+            if behind:
+                suffix = self._ring_suffix(peer_seq)
+                if suffix is not None:
+                    try:
+                        out2 = self._post(
+                            peer.addr, "/v1/internal/raft/append", {
+                                "Term": term,
+                                "LeaderID": self.id,
+                                "LeaderAddr": self.self_addr,
+                                "PrevSeq": peer_seq,
+                                # Suffix covers (peer_seq, last_seq]; the
+                                # in-flight entries (not yet in the ring)
+                                # ride along so an ack means the follower
+                                # really holds them.
+                                "Entries": suffix + entries,
+                            }, timeout=self.rpc_timeout,
+                        )
+                    except (urllib.error.URLError, OSError,
+                            json.JSONDecodeError) as exc:
+                        peer.healthy = False
+                        peer.last_error = str(exc)
+                        return False
+                    if out2.get("OK"):
+                        peer.healthy = True
+                        peer.retry_after = 0.0
+                        log.info("caught %s up by re-send (%d entries)",
+                                 peer.addr, len(suffix))
+                        return True
+            # The write path must NOT install inline: its caller serializes
+            # writes, and a full state transfer would stall them all.
             # The heartbeat loop — no locks held — does the catch-up.
             if not allow_snapshot:
                 peer.healthy = False
@@ -264,6 +382,8 @@ class Replicator:
         """Catch a lagging/diverged follower up with the full FSM image
         (fsm.go:1367 Persist / raft InstallSnapshot analog)."""
         store = self.server.store
+        # Capture (image, seq) atomically, but post OUTSIDE the store lock —
+        # a multi-second network transfer under it would stall every read.
         with store._lock:
             snap = store.to_snapshot_wire()
             seq = self.last_seq
@@ -320,6 +440,9 @@ class Replicator:
                 self.server.store.apply_remote(e)
                 with self._lock:
                     self.last_seq = e["s"]
+                    # Followers keep the ring too: a freshly elected leader
+                    # must be able to repair its peers by re-send.
+                    self._ring_add_locked(e)
             with self._lock:
                 return {"OK": True, "Term": self.term, "Seq": self.last_seq}
 
@@ -359,6 +482,9 @@ class Replicator:
             grant = self.voted_for in (None, candidate) and up_to_date
             if grant:
                 self.voted_for = candidate
+                # Durable BEFORE the response leaves: a restart must not
+                # forget this vote (raft §5.1).
+                self._persist_hard_state_locked()
                 self._last_heartbeat = time.monotonic()
             return {"Granted": grant, "Term": self.term}
 
@@ -386,6 +512,7 @@ class Replicator:
     def _new_term_locked(self, term: int) -> None:
         self.term = term
         self.voted_for = None
+        self._persist_hard_state_locked()
 
     def _become_follower_locked(self) -> None:
         was_leader = self.role == self.LEADER
@@ -439,6 +566,7 @@ class Replicator:
             term = self.term
             self.role = self.CANDIDATE
             self.voted_for = self.id
+            self._persist_hard_state_locked()
             self._last_heartbeat = time.monotonic()
             last_seq = self.last_seq
         votes = 1

@@ -78,6 +78,10 @@ class ServerConfig:
     peers: List[str] = field(default_factory=list)
     election_timeout: tuple = (0.25, 0.5)
     raft_heartbeat_interval: float = 0.08
+    # Shared secret authenticating server↔server raft RPCs; required on
+    # /v1/internal/raft/* when set (otherwise those routes accept loopback
+    # peers only when ACLs are off — see api/http_server.route).
+    cluster_secret: str = ""
     scheduler_config: SchedulerConfiguration = field(
         default_factory=SchedulerConfiguration
     )
@@ -160,6 +164,8 @@ class Server:
             peer_addrs=self.config.peers,
             election_timeout=self.config.election_timeout,
             heartbeat_interval=self.config.raft_heartbeat_interval,
+            cluster_secret=self.config.cluster_secret,
+            state_dir=self.config.data_dir,
         )
         self.store.replicator = self.replicator
 

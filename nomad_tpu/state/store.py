@@ -76,50 +76,66 @@ def journaled(fn):
 
     @functools.wraps(fn)
     def wrapper(self, index, *args, **kwargs):
-        with self._lock:
-            if (
-                (self.wal is None and self.replicator is None)
-                or self._replaying
-                or self._applying_remote
-                or self._journal_depth > 0
-            ):
-                return fn(self, index, *args, **kwargs)
-            if has_now and kwargs.get("now") is None:
-                kwargs["now"] = _time.time()
-            from ..structs import serde
+        # Writers serialize on _write_lock (reentrant — mutators nest);
+        # _lock (the READ lock) is held only for the in-memory apply, NOT
+        # across the replication quorum wait.  Without this split, every
+        # read — scheduler snapshots, blocking queries, HTTP GETs — stalls
+        # behind each write's network round-trip (round-4 advisor finding).
+        with self._write_lock:
+            with self._lock:
+                if (
+                    (self.wal is None and self.replicator is None)
+                    or self._replaying
+                    or self._applying_remote
+                    or self._journal_depth > 0
+                ):
+                    return fn(self, index, *args, **kwargs)
+                if has_now and kwargs.get("now") is None:
+                    kwargs["now"] = _time.time()
+                from ..structs import serde
 
-            args_wire = {
-                "args": [serde.to_wire(a) for a in args],
-                "kwargs": {k: serde.to_wire(v) for k, v in kwargs.items()},
-            }
-            if self.replicator is not None:
-                # Replicate FIRST: a write that cannot reach a quorum
-                # raises before anything lands locally (log or tables), so
-                # an uncommitted entry can never replay after a restart
-                # (raft's commit-then-apply order; replication.py).
-                seq_base = (
-                    self.wal.seq if self.wal is not None
-                    else self.replicator.last_seq
-                )
-                entry = {
-                    "i": index, "s": seq_base + 1, "op": op, "a": args_wire,
+                args_wire = {
+                    "args": [serde.to_wire(a) for a in args],
+                    "kwargs": {
+                        k: serde.to_wire(v) for k, v in kwargs.items()
+                    },
                 }
-                self.replicator.replicate(entry)
-                if self.wal is not None:
-                    self.wal.append_entry(entry)
-            else:
-                self.wal.append(index, op, args_wire)
-            self._journal_depth += 1
-            try:
-                out = fn(self, index, *args, **kwargs)
-            finally:
-                self._journal_depth -= 1
-            if (
-                self.wal is not None
-                and self.wal.appends_since_snapshot >= self.snapshot_every
-            ):
-                self.write_snapshot()
-            return out
+                replicator = self.replicator
+                entry = None
+                if replicator is not None:
+                    seq_base = (
+                        self.wal.seq if self.wal is not None
+                        else replicator.last_seq
+                    )
+                    entry = {
+                        "i": index, "s": seq_base + 1, "op": op,
+                        "a": args_wire,
+                    }
+            if replicator is not None:
+                # Replicate FIRST, with no store lock held: a write that
+                # cannot reach a quorum raises before anything lands
+                # locally (log or tables), so an uncommitted entry can
+                # never replay after a restart (commit-then-apply order;
+                # replication.py).  _write_lock keeps seq assignment and
+                # stream order race-free.
+                replicator.replicate(entry)
+            with self._lock:
+                if entry is not None:
+                    if self.wal is not None:
+                        self.wal.append_entry(entry)
+                else:
+                    self.wal.append(index, op, args_wire)
+                self._journal_depth += 1
+                try:
+                    out = fn(self, index, *args, **kwargs)
+                finally:
+                    self._journal_depth -= 1
+                if (
+                    self.wal is not None
+                    and self.wal.appends_since_snapshot >= self.snapshot_every
+                ):
+                    self.write_snapshot()
+                return out
 
     return wrapper
 
@@ -150,6 +166,10 @@ class StateStore:
 
     def __init__(self, matrix: Optional[NodeMatrix] = None):
         self._lock = threading.RLock()
+        # Serializes journaled writers across the replicate→apply sequence
+        # so _lock can be RELEASED during the quorum network wait (reads
+        # proceed); reentrant because mutators nest (@journaled).
+        self._write_lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self.matrix = matrix if matrix is not None else NodeMatrix()
 
