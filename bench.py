@@ -57,7 +57,14 @@ E2E_PROBES = int(os.environ.get("BENCH_E2E_PROBES", "50"))
 E2E_WORKERS = int(os.environ.get("BENCH_E2E_WORKERS", "32"))
 
 
-PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+# Total probe budget ~10 minutes: 4 attempts x 150s + backoffs (15/30/60).
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
+
+# Per-attempt probe outcomes, surfaced in the output JSON so a CPU
+# fallback is diagnosable from the artifact alone (round-4 verdict: two
+# of four rounds fell back with a single opaque stderr line).
+PROBE_LOG: list = []
 
 
 def _fallback_to_cpu(reason: str) -> None:
@@ -71,44 +78,63 @@ def _fallback_to_cpu(reason: str) -> None:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_CPU_FALLBACK"] = "1"
+    # Carry the probe history across the re-exec into the final JSON.
+    env["BENCH_PROBE_LOG"] = json.dumps(PROBE_LOG + [reason])
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _probe_once() -> str:
+    """One backend-init probe in a DISPOSABLE subprocess (a wedged tunnel
+    hangs forever in-process; the timeout kills the child and the next
+    attempt gets a fresh process + fresh tunnel connection)."""
+    import subprocess
+
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+        )
+    except subprocess.TimeoutExpired:
+        return f"hung >{PROBE_TIMEOUT}s (wedged tunnel?)"
+    if p.returncode != 0:
+        return (f"rc={p.returncode} after {time.time() - t0:.0f}s: "
+                f"{p.stderr.strip()[-300:]}")
+    return "ok:" + p.stdout.strip()
 
 
 def init_backend() -> str:
     """Bring up the jax backend defensively; never burn the whole round.
 
-    Two observed failure modes (round 1 + round 2 verification):
-    - ``jax.devices()`` raises UNAVAILABLE (TPU backend setup error) —
-      retried below with backoff.
+    Two observed failure modes (rounds 1-4):
+    - ``jax.devices()`` raises UNAVAILABLE (TPU backend setup error);
     - ``jax.devices()`` HANGS forever (wedged TPU tunnel; a registered
       plugin backend can block in make_c_api_client).  A hang cannot be
-      recovered in-process, so first PROBE backend init in a disposable
-      subprocess with a timeout; if the probe dies or times out, re-exec
-      with the CPU platform forced so a number (with ``platform``
-      disclosed) is always produced.
+      recovered in-process, so backend init is PROBED in a disposable
+      subprocess, killed on timeout, and retried with backoff (~10 min
+      total budget) — the tunnel often recovers between attempts.  Only
+      after every attempt fails does the bench re-exec with the CPU
+      platform forced, carrying the per-attempt log into the output JSON.
     """
     if (
         os.environ.get("BENCH_CPU_FALLBACK") != "1"
         and os.environ.get("JAX_PLATFORMS") != "cpu"
     ):
-        import subprocess
-
-        try:
-            p = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True,
-                text=True,
-                timeout=PROBE_TIMEOUT,
-            )
-            if p.returncode != 0:
-                _fallback_to_cpu(
-                    f"backend probe failed rc={p.returncode}: "
-                    f"{p.stderr[-500:]}"
-                )
-        except subprocess.TimeoutExpired:
+        for attempt in range(PROBE_ATTEMPTS):
+            out = _probe_once()
+            PROBE_LOG.append(f"attempt {attempt + 1}: {out}")
+            sys.stderr.write(f"bench: probe {PROBE_LOG[-1]}\n")
+            sys.stderr.flush()
+            if out.startswith("ok:"):
+                break
+            if attempt < PROBE_ATTEMPTS - 1:
+                time.sleep(15.0 * (2 ** attempt))
+        else:
             _fallback_to_cpu(
-                f"backend probe hung >{PROBE_TIMEOUT}s (wedged tunnel?)"
+                f"backend probe failed {PROBE_ATTEMPTS}x (see probe_attempts)"
             )
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # A registered TPU-tunnel plugin backend can initialize (and hang)
@@ -220,7 +246,14 @@ def bench_kernel(result: dict) -> None:
     from nomad_tpu.ops.kernels import score_batch
     from nomad_tpu.parallel import build_batch_inputs
 
+    def _mark(msg: str) -> None:
+        # Progress breadcrumbs on stderr: a wedged tunnel run should be
+        # diagnosable from where the trail stops (rounds 2/4 died mute).
+        sys.stderr.write(f"bench: [{time.strftime('%H:%M:%S')}] {msg}\n")
+        sys.stderr.flush()
+
     # Tunnel sync-RTT floor: a trivial jitted op, result fetched.
+    _mark("rtt probe")
     trivial = jax.jit(lambda x: x + 1)
     x = jnp.zeros((8,), jnp.float32)
     np.asarray(trivial(x))
@@ -231,6 +264,7 @@ def bench_kernel(result: dict) -> None:
         rtts.append(time.time() - t)
     result["rtt_floor_ms"] = round(float(np.median(rtts)) * 1000.0, 3)
 
+    _mark(f"rtt_floor={result['rtt_floor_ms']}ms; building cluster")
     m = build_cluster()
     shapes = build_requests(m)
     arrays = m.sync()
@@ -246,11 +280,14 @@ def bench_kernel(result: dict) -> None:
         )
 
     # Warmup (compile + cache).
+    _mark("warmup compile (first dispatch)")
     placed = int((np.asarray(dispatch().rows) >= 0).sum())
+    _mark("warmup done")
     for _ in range(2):
         np.asarray(dispatch().rows)
 
     # Sync latency phase.
+    _mark("sync latency phase")
     times = []
     for _ in range(DISPATCHES):
         t = time.time()
@@ -260,6 +297,7 @@ def bench_kernel(result: dict) -> None:
     sync_rate = DISPATCHES * BATCH / float(arr.sum())
 
     # Pipelined throughput phase (the headline number).
+    _mark(f"pipelined phase (sync rate {sync_rate:.0f}/s)")
     n_pipe = max(DISPATCHES, PIPELINE_DEPTH * 4)
     t0 = time.time()
     inflight = []
@@ -277,6 +315,12 @@ def bench_kernel(result: dict) -> None:
         vs_baseline=round(pipe_rate / 50000.0, 3),
         sync_evals_per_sec=round(sync_rate, 1),
         p99_ms=round(float(np.percentile(arr, 99) * 1000.0), 3),
+        # The tunnel RTT floor is not software-addressable; the net
+        # number is what the 5ms target judges (LATENCY.md).
+        p99_net_of_rtt_ms=round(
+            float(np.percentile(arr, 99) * 1000.0) - result["rtt_floor_ms"],
+            3,
+        ),
         max_ms=round(float(arr.max()) * 1000.0, 3),
         per_eval_us=round(1e6 / pipe_rate, 2),
         batch=BATCH,
@@ -440,6 +484,11 @@ def main() -> None:
         "vs_baseline": 0.0,
         "platform": platform,
     }
+    probe_log = PROBE_LOG or json.loads(
+        os.environ.get("BENCH_PROBE_LOG", "[]")
+    )
+    if probe_log:
+        result["probe_attempts"] = probe_log
     bench_kernel(result)
     if E2E:
         try:

@@ -1,0 +1,637 @@
+// nomad_tpu native executor sidecar.
+//
+// The native-runtime half of the exec driver's process boundary: a C++
+// re-implementation of nomad_tpu/client/executor.py speaking the exact
+// same newline-delimited-JSON protocol over a unix socket, so
+// client/driver.py's SidecarClient can spawn either interchangeably
+// (reference analog: drivers/shared/executor/ is compiled Go supervising
+// tasks behind gRPC; here the supervisor is native C++ and the wire is
+// JSON lines).
+//
+// Ops (one JSON object per line):
+//   ping                                -> {pong: true, pid}
+//   start {id, argv, env, cwd, stdout, stderr, rlimits{}, cgroup}
+//                                       -> {pid, start_ts}
+//   wait {id}                           -> {running} | {exit_code, signal}
+//   stop {id, grace}                    -> {}
+//   destroy {id}                        -> {}
+//   recover {id, pid, start_ts}         -> {ok}
+//   list                                -> {tasks: {id: {...}}}
+//   shutdown                            -> {} (exits; tasks keep running)
+//
+// Isolation on start: setsid (own session -> group kills), RLIMIT_* from
+// the request, best-effort cgroup v2 scope.  State: every mutation
+// rewrites <state-dir>/executor.state.json so a replacement sidecar can
+// recover supervised pids after kill -9.
+//
+// Build: make -C native   (g++ -std=c++17 -pthread; no dependencies)
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/resource.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON (objects, arrays, strings, numbers, bools, null) — enough
+// for this protocol; no external dependencies.
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum Type { NUL, BOOL, NUM, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  static Json S(const std::string& s) {
+    Json j; j.type = STR; j.str = s; return j;
+  }
+  static Json N(double d) { Json j; j.type = NUM; j.num = d; return j; }
+  static Json B(bool v) { Json j; j.type = BOOL; j.b = v; return j; }
+  static Json O() { Json j; j.type = OBJ; return j; }
+
+  bool has(const std::string& k) const { return obj.count(k) > 0; }
+  const Json& at(const std::string& k) const {
+    static Json null;
+    auto it = obj.find(k);
+    return it == obj.end() ? null : it->second;
+  }
+  std::string s(const std::string& k, const std::string& d = "") const {
+    const Json& v = at(k);
+    return v.type == STR ? v.str : d;
+  }
+  double n(const std::string& k, double d = 0) const {
+    const Json& v = at(k);
+    return v.type == NUM ? v.num : d;
+  }
+  bool truthy(const std::string& k) const {
+    const Json& v = at(k);
+    return (v.type == BOOL && v.b) || (v.type == NUM && v.num != 0) ||
+           (v.type == STR && !v.str.empty());
+  }
+};
+
+struct Parser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit Parser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() { while (p < end && isspace((unsigned char)*p)) ++p; }
+  bool eat(char c) {
+    ws();
+    if (p < end && *p == c) { ++p; return true; }
+    return false;
+  }
+
+  Json parse() {
+    ws();
+    if (p >= end) { ok = false; return {}; }
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return string_();
+      case 't': case 'f': return boolean();
+      case 'n': p += 4; return {};
+      default: return number();
+    }
+  }
+
+  Json object() {
+    Json j; j.type = Json::OBJ;
+    ++p;  // {
+    ws();
+    if (eat('}')) return j;
+    while (ok) {
+      ws();
+      if (p >= end || *p != '"') { ok = false; break; }
+      Json key = string_();
+      if (!eat(':')) { ok = false; break; }
+      j.obj[key.str] = parse();
+      if (eat(',')) continue;
+      if (eat('}')) break;
+      ok = false;
+    }
+    return j;
+  }
+
+  Json array() {
+    Json j; j.type = Json::ARR;
+    ++p;  // [
+    ws();
+    if (eat(']')) return j;
+    while (ok) {
+      j.arr.push_back(parse());
+      if (eat(',')) continue;
+      if (eat(']')) break;
+      ok = false;
+    }
+    return j;
+  }
+
+  Json string_() {
+    Json j; j.type = Json::STR;
+    ++p;  // "
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': j.str += '\n'; break;
+          case 't': j.str += '\t'; break;
+          case 'r': j.str += '\r'; break;
+          case 'b': j.str += '\b'; break;
+          case 'f': j.str += '\f'; break;
+          case 'u': {
+            if (p + 4 < end) {
+              unsigned code = strtoul(std::string(p + 1, p + 5).c_str(),
+                                      nullptr, 16);
+              // BMP-only UTF-8 encoding (paths/env rarely need more).
+              if (code < 0x80) {
+                j.str += (char)code;
+              } else if (code < 0x800) {
+                j.str += (char)(0xC0 | (code >> 6));
+                j.str += (char)(0x80 | (code & 0x3F));
+              } else {
+                j.str += (char)(0xE0 | (code >> 12));
+                j.str += (char)(0x80 | ((code >> 6) & 0x3F));
+                j.str += (char)(0x80 | (code & 0x3F));
+              }
+              p += 4;
+            }
+            break;
+          }
+          default: j.str += *p;
+        }
+      } else {
+        j.str += *p;
+      }
+      ++p;
+    }
+    if (p < end) ++p;  // closing "
+    return j;
+  }
+
+  Json boolean() {
+    if (*p == 't') { p += 4; return Json::B(true); }
+    p += 5;
+    return Json::B(false);
+  }
+
+  Json number() {
+    char* q = nullptr;
+    double v = strtod(p, &q);
+    if (q == p) { ok = false; return {}; }
+    p = q;
+    return Json::N(v);
+  }
+};
+
+static void dump(const Json& j, std::string& out) {
+  char buf[64];
+  switch (j.type) {
+    case Json::NUL: out += "null"; break;
+    case Json::BOOL: out += j.b ? "true" : "false"; break;
+    case Json::NUM:
+      if (j.num == (long long)j.num) {
+        snprintf(buf, sizeof buf, "%lld", (long long)j.num);
+      } else {
+        snprintf(buf, sizeof buf, "%.6f", j.num);
+      }
+      out += buf;
+      break;
+    case Json::STR: {
+      out += '"';
+      for (char c : j.str) {
+        switch (c) {
+          case '"': out += "\\\""; break;
+          case '\\': out += "\\\\"; break;
+          case '\n': out += "\\n"; break;
+          case '\t': out += "\\t"; break;
+          case '\r': out += "\\r"; break;
+          default:
+            if ((unsigned char)c < 0x20) {
+              snprintf(buf, sizeof buf, "\\u%04x", c);
+              out += buf;
+            } else {
+              out += c;
+            }
+        }
+      }
+      out += '"';
+      break;
+    }
+    case Json::ARR: {
+      out += '[';
+      for (size_t i = 0; i < j.arr.size(); ++i) {
+        if (i) out += ',';
+        dump(j.arr[i], out);
+      }
+      out += ']';
+      break;
+    }
+    case Json::OBJ: {
+      out += '{';
+      bool first = true;
+      for (auto& kv : j.obj) {
+        if (!first) out += ',';
+        first = false;
+        dump(Json::S(kv.first), out);
+        out += ':';
+        dump(kv.second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+static std::string dumps(const Json& j) {
+  std::string out;
+  dump(j, out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Supervised-task table + state file
+// ---------------------------------------------------------------------------
+
+struct Sup {
+  pid_t pid = 0;
+  double start_ts = 0;
+  bool child = false;  // our fork (waitpid) vs recovered (poll)
+  bool done = false;
+  int exit_code = 0;
+  int term_signal = 0;
+  std::string cgroup;
+};
+
+static std::mutex g_mu;
+static std::map<std::string, std::shared_ptr<Sup>> g_tasks;
+static std::string g_state_path;
+
+static double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec + ts.tv_nsec / 1e9;
+}
+
+static bool pid_alive(pid_t pid) {
+  return pid > 0 && (kill(pid, 0) == 0 || errno == EPERM);
+}
+
+static void kill_group(pid_t pid, int sig) {
+  if (pid <= 0) return;
+  if (kill(-pid, sig) != 0) kill(pid, sig);
+}
+
+static void save_state() {
+  Json root = Json::O();
+  root.obj["pid"] = Json::N(getpid());
+  Json tasks = Json::O();
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    for (auto& kv : g_tasks) {
+      if (kv.second->done) continue;
+      Json t = Json::O();
+      t.obj["pid"] = Json::N(kv.second->pid);
+      t.obj["start_ts"] = Json::N(kv.second->start_ts);
+      tasks.obj[kv.first] = t;
+    }
+  }
+  root.obj["tasks"] = tasks;
+  std::string data = dumps(root);
+  std::string tmp = g_state_path + ".tmp";
+  FILE* fh = fopen(tmp.c_str(), "w");
+  if (!fh) return;
+  fwrite(data.data(), 1, data.size(), fh);
+  fclose(fh);
+  rename(tmp.c_str(), g_state_path.c_str());
+}
+
+static void reap_thread(std::string id, std::shared_ptr<Sup> sup) {
+  if (sup->child) {
+    int status = 0;
+    while (waitpid(sup->pid, &status, 0) < 0 && errno == EINTR) {
+    }
+    if (WIFEXITED(status)) {
+      sup->exit_code = WEXITSTATUS(status);
+    } else if (WIFSIGNALED(status)) {
+      sup->term_signal = WTERMSIG(status);
+    }
+  } else {
+    // Recovered (reparented) task: exit status unobservable; poll.
+    while (pid_alive(sup->pid)) usleep(200 * 1000);
+  }
+  sup->done = true;
+  if (!sup->cgroup.empty()) rmdir(sup->cgroup.c_str());
+  save_state();
+}
+
+// ---------------------------------------------------------------------------
+// Ops
+// ---------------------------------------------------------------------------
+
+static const std::map<std::string, int> kRlimits = {
+    {"cpu", RLIMIT_CPU},     {"nofile", RLIMIT_NOFILE},
+    {"as", RLIMIT_AS},       {"fsize", RLIMIT_FSIZE},
+    {"nproc", RLIMIT_NPROC},
+};
+
+static Json op_start(const Json& req) {
+  std::string id = req.s("id");
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_tasks.find(id);
+    if (it != g_tasks.end() && !it->second->done) {
+      // Idempotent: a retried start must not launch a second copy.
+      Json out = Json::O();
+      out.obj["pid"] = Json::N(it->second->pid);
+      out.obj["start_ts"] = Json::N(it->second->start_ts);
+      return out;
+    }
+  }
+  const Json& argv_j = req.at("argv");
+  if (argv_j.type != Json::ARR || argv_j.arr.empty()) {
+    Json e = Json::O();
+    e.obj["error"] = Json::S("start requires argv");
+    return e;
+  }
+  std::vector<std::string> argv;
+  for (auto& a : argv_j.arr) argv.push_back(a.str);
+  std::vector<std::string> envs;
+  for (auto& kv : req.at("env").obj)
+    envs.push_back(kv.first + "=" + kv.second.str);
+
+  std::string cgroup;
+  if (req.truthy("cgroup")) {
+    std::string base = "/sys/fs/cgroup/nomad_tpu";
+    mkdir(base.c_str(), 0755);
+    cgroup = base + "/" + id;
+    if (mkdir(cgroup.c_str(), 0755) != 0 && errno != EEXIST) cgroup.clear();
+  }
+
+  int devnull = open("/dev/null", O_RDONLY);
+  pid_t pid = fork();
+  if (pid == 0) {
+    // Child: own session (group kills + survives the sidecar), rlimits,
+    // redirections, then exec.
+    setsid();
+    for (auto& kv : req.at("rlimits").obj) {
+      auto it = kRlimits.find(kv.first);
+      if (it != kRlimits.end()) {
+        struct rlimit rl;
+        rl.rlim_cur = rl.rlim_max = (rlim_t)kv.second.num;
+        setrlimit(it->second, &rl);
+      }
+    }
+    std::string cwd = req.s("cwd");
+    if (!cwd.empty() && chdir(cwd.c_str()) != 0) _exit(127);
+    int out = open(req.s("stdout").c_str(), O_WRONLY | O_CREAT | O_APPEND,
+                   0644);
+    int err = open(req.s("stderr").c_str(), O_WRONLY | O_CREAT | O_APPEND,
+                   0644);
+    if (devnull >= 0) dup2(devnull, 0);
+    if (out >= 0) dup2(out, 1);
+    if (err >= 0) dup2(err, 2);
+    std::vector<char*> cargv;
+    for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+    cargv.push_back(nullptr);
+    std::vector<char*> cenv;
+    for (auto& e : envs) cenv.push_back(const_cast<char*>(e.c_str()));
+    cenv.push_back(nullptr);
+    execve(cargv[0], cargv.data(),
+           envs.empty() ? environ : cenv.data());
+    _exit(127);
+  }
+  if (devnull >= 0) close(devnull);
+  if (pid < 0) {
+    Json e = Json::O();
+    e.obj["error"] = Json::S(std::string("fork failed: ") + strerror(errno));
+    return e;
+  }
+  if (!cgroup.empty()) {
+    std::string procs = cgroup + "/cgroup.procs";
+    FILE* fh = fopen(procs.c_str(), "w");
+    if (fh) {
+      fprintf(fh, "%d", pid);
+      fclose(fh);
+    } else {
+      cgroup.clear();
+    }
+  }
+  auto sup = std::make_shared<Sup>();
+  sup->pid = pid;
+  sup->start_ts = now_s();
+  sup->child = true;
+  sup->cgroup = cgroup;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_tasks[id] = sup;
+  }
+  save_state();
+  std::thread(reap_thread, id, sup).detach();
+  Json out = Json::O();
+  out.obj["pid"] = Json::N(pid);
+  out.obj["start_ts"] = Json::N(sup->start_ts);
+  return out;
+}
+
+static Json op_wait(const Json& req) {
+  std::shared_ptr<Sup> sup;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_tasks.find(req.s("id"));
+    if (it != g_tasks.end()) sup = it->second;
+  }
+  Json out = Json::O();
+  if (!sup) {
+    out.obj["error"] = Json::S("unknown task");
+    return out;
+  }
+  if (!sup->done) {
+    out.obj["running"] = Json::B(true);
+    return out;
+  }
+  out.obj["exit_code"] = Json::N(sup->exit_code);
+  out.obj["signal"] = Json::N(sup->term_signal);
+  out.obj["recovered"] = Json::B(!sup->child);
+  return out;
+}
+
+static Json op_stop(const Json& req) {
+  std::shared_ptr<Sup> sup;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_tasks.find(req.s("id"));
+    if (it != g_tasks.end()) sup = it->second;
+  }
+  if (sup && !sup->done) {
+    double grace = req.n("grace", 5.0);
+    kill_group(sup->pid, SIGTERM);
+    std::thread([sup, grace] {
+      usleep((useconds_t)(grace * 1e6));
+      if (!sup->done) kill_group(sup->pid, SIGKILL);
+    }).detach();
+  }
+  return Json::O();
+}
+
+static Json op_destroy(const Json& req) {
+  std::shared_ptr<Sup> sup;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_tasks.find(req.s("id"));
+    if (it != g_tasks.end()) {
+      sup = it->second;
+      g_tasks.erase(it);
+    }
+  }
+  if (sup && !sup->done) kill_group(sup->pid, SIGKILL);
+  save_state();
+  return Json::O();
+}
+
+static Json op_recover(const Json& req) {
+  pid_t pid = (pid_t)req.n("pid");
+  Json out = Json::O();
+  if (!pid_alive(pid)) {
+    out.obj["ok"] = Json::B(false);
+    return out;
+  }
+  auto sup = std::make_shared<Sup>();
+  sup->pid = pid;
+  sup->start_ts = req.n("start_ts");
+  sup->child = false;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_tasks[req.s("id")] = sup;
+  }
+  save_state();
+  std::thread(reap_thread, req.s("id"), sup).detach();
+  out.obj["ok"] = Json::B(true);
+  return out;
+}
+
+static Json op_list(const Json&) {
+  Json tasks = Json::O();
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : g_tasks) {
+    Json t = Json::O();
+    t.obj["pid"] = Json::N(kv.second->pid);
+    t.obj["start_ts"] = Json::N(kv.second->start_ts);
+    t.obj["running"] = Json::B(!kv.second->done);
+    tasks.obj[kv.first] = t;
+  }
+  Json out = Json::O();
+  out.obj["tasks"] = tasks;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Socket server (thread per connection, newline-delimited JSON)
+// ---------------------------------------------------------------------------
+
+static void handle_conn(int fd) {
+  std::string buf;
+  char chunk[4096];
+  for (;;) {
+    ssize_t n = read(fd, chunk, sizeof chunk);
+    if (n <= 0) break;
+    buf.append(chunk, n);
+    size_t pos;
+    while ((pos = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, pos);
+      buf.erase(0, pos + 1);
+      if (line.empty()) continue;
+      Parser parser(line);
+      Json req = parser.parse();
+      Json out;
+      std::string op = parser.ok ? req.s("op") : "";
+      if (!parser.ok) {
+        out = Json::O();
+        out.obj["error"] = Json::S("bad json");
+      } else if (op == "ping") {
+        out = Json::O();
+        out.obj["pong"] = Json::B(true);
+        out.obj["pid"] = Json::N(getpid());
+        out.obj["native"] = Json::B(true);
+      } else if (op == "start") {
+        out = op_start(req);
+      } else if (op == "wait") {
+        out = op_wait(req);
+      } else if (op == "stop") {
+        out = op_stop(req);
+      } else if (op == "destroy") {
+        out = op_destroy(req);
+      } else if (op == "recover") {
+        out = op_recover(req);
+      } else if (op == "list") {
+        out = op_list(req);
+      } else if (op == "shutdown") {
+        std::string resp = "{}\n";
+        (void)!write(fd, resp.data(), resp.size());
+        _exit(0);
+      } else {
+        out = Json::O();
+        out.obj["error"] = Json::S("bad op '" + op + "'");
+      }
+      std::string resp = dumps(out) + "\n";
+      if (write(fd, resp.data(), resp.size()) < 0) break;
+    }
+  }
+  close(fd);
+}
+
+int main(int argc, char** argv) {
+  std::string sock_path, state_dir;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    if (!strcmp(argv[i], "--socket")) sock_path = argv[i + 1];
+    if (!strcmp(argv[i], "--state-dir")) state_dir = argv[i + 1];
+  }
+  if (sock_path.empty() || state_dir.empty()) {
+    fprintf(stderr, "usage: %s --socket PATH --state-dir DIR\n", argv[0]);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  mkdir(state_dir.c_str(), 0755);
+  g_state_path = state_dir + "/executor.state.json";
+  save_state();  // truncate: this sidecar's own (empty) table
+
+  unlink(sock_path.c_str());
+  int sfd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sfd < 0) return 1;
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, sock_path.c_str(), sizeof addr.sun_path - 1);
+  if (bind(sfd, (struct sockaddr*)&addr, sizeof addr) != 0) return 1;
+  if (listen(sfd, 64) != 0) return 1;
+  for (;;) {
+    int fd = accept(sfd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::thread(handle_conn, fd).detach();
+  }
+  return 0;
+}

@@ -72,15 +72,27 @@ class Agent:
             self, host=self.config.http_host, port=self.config.http_port
         )
         self.rpc_addr = self.http.addr
-        if self.server is not None and self.config.server_config.peers:
+        if self.server is not None and (
+            self.config.server_config.peers
+            or self.config.server_config.raft_enabled
+        ):
             # Multi-server: join the peer set as a follower; the election
-            # promotes one leader (server/replication.py).
+            # promotes one leader (server/replication.py).  raft_enabled
+            # covers the single-server-that-grows case (`server join`).
             self.server.setup_replication(self.rpc_addr)
 
     def start(self) -> None:
         self.started_at = time.time()
         if self.server is not None:
             self.server.start()
+            rep = self.server.replicator
+            if rep is not None and self.client is not None:
+                # The in-process client registers through direct server
+                # calls (no leader-redirect retry on that seam): wait out
+                # the first election so its boot writes don't race it.
+                deadline = time.time() + 10.0
+                while time.time() < deadline and not rep.leader_addr:
+                    time.sleep(0.05)
         if self.client is not None:
             # Advertise this agent's HTTP address on the node so servers
             # can forward task-fs/log requests to it (the reference

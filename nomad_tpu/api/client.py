@@ -132,6 +132,174 @@ class APIClient:
             "GET", f"/v1/job/{job_id}/summary?namespace={namespace}"
         )
 
+    def dispatch_job(
+        self, job_id: str, payload: bytes = b"", meta: Optional[Dict] = None,
+        namespace: str = "default",
+    ) -> Dict:
+        import base64
+
+        return self._call(
+            "PUT", f"/v1/job/{job_id}/dispatch?namespace={namespace}",
+            {
+                "Payload": base64.b64encode(payload).decode()
+                if payload else "",
+                "Meta": meta or {},
+            },
+        )
+
+    def job_versions(self, job_id: str, namespace: str = "default") -> Dict:
+        return self._call(
+            "GET", f"/v1/job/{job_id}/versions?namespace={namespace}"
+        )
+
+    def revert_job(
+        self, job_id: str, version: Optional[int] = None,
+        namespace: str = "default",
+    ) -> Dict:
+        body: Dict = {"Namespace": namespace}
+        if version is not None:
+            body["JobVersion"] = version
+        return self._call("PUT", f"/v1/job/{job_id}/revert", body)
+
+    def scale_job(
+        self, job_id: str, group: str, count: int, message: str = "",
+        namespace: str = "default",
+    ) -> Dict:
+        return self._call(
+            "PUT", f"/v1/job/{job_id}/scale",
+            {
+                "Namespace": namespace, "Count": count,
+                "Target": {"Group": group}, "Message": message,
+            },
+        )
+
+    def job_scale_status(
+        self, job_id: str, namespace: str = "default"
+    ) -> Dict:
+        return self._call(
+            "GET", f"/v1/job/{job_id}/scale?namespace={namespace}"
+        )
+
+    def job_deployments(self, job_id: str, namespace: str = "default"):
+        return self._call(
+            "GET", f"/v1/job/{job_id}/deployments?namespace={namespace}"
+        )
+
+    # Deployments ------------------------------------------------------
+
+    def list_deployments(self, namespace: str = "default") -> List[Dict]:
+        return self._call("GET", f"/v1/deployments?namespace={namespace}")
+
+    def get_deployment(self, deployment_id: str) -> Dict:
+        return self._call("GET", f"/v1/deployment/{deployment_id}")
+
+    def deployment_allocations(self, deployment_id: str) -> List[Dict]:
+        return self._call(
+            "GET", f"/v1/deployment/{deployment_id}/allocations"
+        )
+
+    def promote_deployment(
+        self, deployment_id: str, groups: Optional[List[str]] = None
+    ) -> Dict:
+        body: Dict = {"All": True} if not groups else {"Groups": groups}
+        return self._call(
+            "PUT", f"/v1/deployment/{deployment_id}/promote", body
+        )
+
+    def fail_deployment(self, deployment_id: str) -> Dict:
+        return self._call("PUT", f"/v1/deployment/{deployment_id}/fail", {})
+
+    def pause_deployment(self, deployment_id: str, pause: bool = True) -> Dict:
+        return self._call(
+            "PUT", f"/v1/deployment/{deployment_id}/pause", {"Pause": pause}
+        )
+
+    # System -----------------------------------------------------------
+
+    def system_gc(self) -> Dict:
+        return self._call("PUT", "/v1/system/gc", {})
+
+    def alloc_exec(
+        self, alloc_id: str, task: str, argv: List[str],
+        stdin: bytes = b"", timeout: float = 300.0,
+    ):
+        """Run a command in a task's context; returns (exit_code, stdout,
+        stderr).  Streams NDJSON frames from /v1/client/exec/."""
+        import base64
+        import urllib.request
+
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
+        req = urllib.request.Request(
+            f"{self.address}/v1/client/exec/{alloc_id}",
+            data=json.dumps({
+                "Task": task,
+                "Cmd": list(argv),
+                "Stdin": base64.b64encode(stdin).decode() if stdin else "",
+                "Timeout": timeout,
+            }).encode(),
+            method="POST",
+            headers=headers,
+        )
+        out, err, code = b"", b"", -1
+        try:
+            with urllib.request.urlopen(req, timeout=timeout + 30) as resp:
+                for line in resp:
+                    if not line.strip():
+                        continue
+                    frame = json.loads(line)
+                    if "stdout" in frame:
+                        out += base64.b64decode(frame["stdout"])
+                    if "stderr" in frame:
+                        err += base64.b64decode(frame["stderr"])
+                    if "error" in frame:
+                        raise APIError(500, frame["error"])
+                    if "exit" in frame:
+                        code = int(frame["exit"])
+        except urllib.error.HTTPError as exc:
+            try:
+                msg = json.loads(exc.read()).get("error", str(exc))
+            except Exception:  # noqa: BLE001
+                msg = str(exc)
+            raise APIError(exc.code, msg) from exc
+        return code, out, err
+
+    # Volumes ----------------------------------------------------------
+
+    def list_volumes(self, namespace: str = "default") -> List[Dict]:
+        return self._call("GET", f"/v1/volumes?namespace={namespace}")
+
+    def register_volume(self, spec: Dict, namespace: str = "default") -> Dict:
+        return self._call(
+            "PUT", f"/v1/volumes?namespace={namespace}", {"Volume": spec}
+        )
+
+    def get_volume(self, volume_id: str, namespace: str = "default") -> Dict:
+        return self._call(
+            "GET", f"/v1/volume/{volume_id}?namespace={namespace}"
+        )
+
+    def deregister_volume(
+        self, volume_id: str, namespace: str = "default"
+    ) -> Dict:
+        return self._call(
+            "DELETE", f"/v1/volume/{volume_id}?namespace={namespace}"
+        )
+
+    def server_join(self, addr: str) -> Dict:
+        return self._call("PUT", "/v1/operator/raft/join", {"Addr": addr})
+
+    def server_remove_peer(self, addr: str) -> Dict:
+        return self._call(
+            "PUT", "/v1/operator/raft/remove-peer", {"Addr": addr}
+        )
+
+    def list_scaling_policies(self, namespace: str = "default"):
+        return self._call(
+            "GET", f"/v1/scaling/policies?namespace={namespace}"
+        )
+
     def parse_job_hcl(self, hcl: str) -> Dict:
         return self._call("POST", "/v1/jobs/parse", {"JobHCL": hcl})
 

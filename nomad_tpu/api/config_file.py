@@ -92,6 +92,9 @@ def apply_config(doc: Dict, agent_config) -> None:
         peers = srv.get("peers")
         if peers:
             sc.peers = list(peers)
+        sc.raft_enabled = bool(srv.get("raft_enabled", sc.raft_enabled))
+        if srv.get("cluster_secret"):
+            sc.cluster_secret = str(srv["cluster_secret"])
         if srv.get("heartbeat_min_ttl"):
             sc.heartbeat_min_ttl = float(srv["heartbeat_min_ttl"])
         if srv.get("heartbeat_max_ttl"):
@@ -112,3 +115,13 @@ def apply_config(doc: Dict, agent_config) -> None:
         meta = cli.get("meta")
         if isinstance(meta, dict):
             cc.meta.update({k: str(v) for k, v in meta.items()})
+        if cli.get("artifact_root"):
+            cc.artifact_root = str(cli["artifact_root"])
+        # host_volume "name" { path = "/export/x" } blocks.
+        hv = cli.get("host_volume")
+        if isinstance(hv, dict):
+            for name, body in hv.items():
+                bodies = body if isinstance(body, list) else [body]
+                for b in bodies:
+                    if isinstance(b, dict) and b.get("path"):
+                        cc.host_volumes[name] = str(b["path"])

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import re
 import sys
 import threading
@@ -93,6 +94,22 @@ class HTTPAPIServer:
                         )
                         api.serve_client_fs(
                             self, parsed.path, query, token=fs_token
+                        )
+                        return
+                    if parsed.path.startswith("/v1/client/exec/") and (
+                        method in ("POST", "PUT")
+                    ):
+                        # NDJSON-framed command execution in a task's
+                        # context (alloc exec).
+                        ln = int(self.headers.get("Content-Length", 0) or 0)
+                        raw = self.rfile.read(ln) if ln else b""
+                        exec_body = json.loads(raw) if raw else {}
+                        exec_token = self.headers.get(
+                            "X-Nomad-Token", query.get("token", "")
+                        )
+                        api.serve_client_exec(
+                            self, parsed.path, query, exec_body,
+                            token=exec_token,
                         )
                         return
                     length = int(self.headers.get("Content-Length", 0) or 0)
@@ -259,14 +276,34 @@ class HTTPAPIServer:
             # (namespace, id), so the queried ns IS the resource's); write
             # bodies that carry their own Namespace are re-checked against
             # it by the route handlers (_require_ns_cap).
+            from ..acl import CAP_DISPATCH_JOB, CAP_SCALE_JOB
+
             ns = query.get("namespace", "default")
             cap = CAP_READ_JOB if read else CAP_SUBMIT_JOB
+            # Anchored on the suffix AFTER a job id (a job literally
+            # named "dispatch"/"scale" must not trip these).
+            if re.match(r"^/v1/job/.+/dispatch$", path):
+                cap = CAP_DISPATCH_JOB
+            elif re.match(r"^/v1/job/.+/scale$", path) and not read:
+                cap = CAP_SCALE_JOB
             if not acl.allow_namespace(ns, cap):
                 raise HTTPError(403, f"Permission denied ({cap})")
             return
         if path.startswith("/v1/allocation") or path.startswith(
             "/v1/evaluation"
-        ) or path == "/v1/deployments" or path.startswith("/v1/deployment"):
+        ) or path == "/v1/deployments" or path.startswith(
+            "/v1/deployment"
+        ) or path.startswith("/v1/scaling") or path.startswith(
+            "/v1/volume"
+        ):
+            if not read and path.startswith("/v1/volume"):
+                # register/deregister: handler enforces submit-job on the
+                # volume's own namespace.
+                return
+            if not read and path.startswith("/v1/deployment"):
+                # promote/fail/pause: the handler enforces submit-job on
+                # the DEPLOYMENT's namespace (the query ns can't see it).
+                return
             ns = query.get("namespace", "default")
             if not acl.allow_namespace(ns, CAP_READ_JOB):
                 raise HTTPError(403, "Permission denied (read-job)")
@@ -427,21 +464,13 @@ class HTTPAPIServer:
     # forwards over the reverse yamux session, nomad/client_rpc.go)
     # ------------------------------------------------------------------
 
-    def serve_client_fs(
-        self, handler, path: str, query: Dict, token: str = ""
-    ) -> None:
-        from ..acl import CAP_READ_FS, CAP_READ_LOGS
-
-        cap = CAP_READ_LOGS if "/logs/" in path else CAP_READ_FS
-
-        m = re.match(r"^/v1/client/fs/(ls|cat|logs)/([^/?]+)$", path)
-        if not m:
-            raise HTTPError(404, f"unknown fs route {path}")
-        op, alloc_id = m.group(1), m.group(2)
-
-        # The capability is checked against the ALLOCATION's namespace
-        # (a query parameter would let a token authorized in one namespace
-        # read another namespace's task files).
+    def _authorize_alloc_ns(self, alloc_id: str, cap: str, token: str) -> None:
+        """Resolve the ALLOCATION's namespace (a query parameter would let
+        a token authorized in one namespace touch another's tasks) and
+        enforce ``cap`` on it — via local token resolution on server
+        agents, or a forwarded capability check on client-only agents
+        (the reference's clients resolve ACLs via server RPC too).
+        Shared by the fs/logs and exec surfaces."""
         client = self.agent.client
         server = self.agent.server
         ns = None
@@ -459,18 +488,30 @@ class HTTPAPIServer:
                 if acl is None or not acl.allow_namespace(ns, cap):
                     raise HTTPError(403, f"Permission denied ({cap})")
         elif client is not None:
-            # Client-only agent: it cannot resolve tokens itself — forward
-            # the capability check to its server (the reference's clients
-            # resolve ACLs via server RPC too). Reaching the node agent
-            # directly must not bypass the ACLs the server enforces.
+            # Reaching the node agent directly must not bypass the ACLs
+            # the server enforces; fail closed when the check is down.
             try:
                 allowed = client.server.check_acl_capability(
                     token, "namespace", cap, ns
                 )
-            except Exception as exc:  # noqa: BLE001 — fail closed
+            except Exception as exc:  # noqa: BLE001
                 raise HTTPError(502, f"ACL check unavailable: {exc}")
             if not allowed:
                 raise HTTPError(403, f"Permission denied ({cap})")
+
+    def serve_client_fs(
+        self, handler, path: str, query: Dict, token: str = ""
+    ) -> None:
+        from ..acl import CAP_READ_FS, CAP_READ_LOGS
+
+        cap = CAP_READ_LOGS if "/logs/" in path else CAP_READ_FS
+
+        m = re.match(r"^/v1/client/fs/(ls|cat|logs)/([^/?]+)$", path)
+        if not m:
+            raise HTTPError(404, f"unknown fs route {path}")
+        op, alloc_id = m.group(1), m.group(2)
+        self._authorize_alloc_ns(alloc_id, cap, token)
+        client = self.agent.client
 
         if client is None or alloc_id not in client.allocs:
             self._forward_client_fs(handler, path, query, alloc_id, token)
@@ -544,6 +585,137 @@ class HTTPAPIServer:
             pass  # reader went away / alloc dir removed
         except Exception:  # noqa: BLE001 — alloc GC'd mid-follow
             pass
+
+    def serve_client_exec(
+        self, handler, path: str, query: Dict, body: Dict, token: str = ""
+    ) -> None:
+        """Run a command in a task's context and stream NDJSON frames
+        ({"stdout": b64} / {"stderr": b64} / {"exit": code}) — the
+        alloc-exec surface (plugins/drivers/execstreaming.go; the
+        reference's live pty bidi is trimmed to stdin-upfront over plain
+        HTTP, which covers piped stdin and one-shot commands)."""
+        import base64
+        import subprocess
+
+        from ..acl import CAP_ALLOC_EXEC
+
+        m = re.match(r"^/v1/client/exec/([^/?]+)$", path)
+        if not m:
+            raise HTTPError(404, f"unknown exec route {path}")
+        alloc_id = m.group(1)
+        client = self.agent.client
+        self._authorize_alloc_ns(alloc_id, CAP_ALLOC_EXEC, token)
+
+        if client is None or alloc_id not in client.allocs:
+            self._forward_client_exec(handler, path, body, alloc_id, token)
+            return
+
+        task = body.get("Task", "")
+        argv = [str(a) for a in body.get("Cmd") or []]
+        if not argv:
+            raise HTTPError(400, "missing Cmd")
+        ar = client.allocs[alloc_id]
+        if not task and len(ar.runners) == 1:
+            task = next(iter(ar.runners))
+        runner = ar.runners.get(task)
+        if runner is None:
+            raise HTTPError(404, f"unknown task {task!r}")
+        task_dir = runner.task_dir
+        env = dict(os.environ)
+        env.update({
+            k: str(v) for k, v in (runner.task.env or {}).items()
+        })
+        stdin = base64.b64decode(body.get("Stdin", "") or "")
+
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+
+        def frame(obj) -> None:
+            handler.wfile.write((json.dumps(obj) + "\n").encode())
+            handler.wfile.flush()
+
+        try:
+            proc = subprocess.Popen(
+                argv, cwd=task_dir, env=env,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        except OSError as exc:
+            frame({"error": str(exc)})
+            return
+        try:
+            out, err = proc.communicate(stdin, timeout=float(
+                body.get("Timeout", 300.0)
+            ))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            frame({"error": "command timed out"})
+        try:
+            for chunk_name, data in (("stdout", out), ("stderr", err)):
+                for i in range(0, len(data), 65536):
+                    frame({
+                        chunk_name: base64.b64encode(
+                            data[i:i + 65536]
+                        ).decode()
+                    })
+            frame({"exit": proc.returncode})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
+    def _forward_client_exec(
+        self, handler, path: str, body: Dict, alloc_id: str, token: str
+    ) -> None:
+        """Server leg: forward the exec request to the node agent holding
+        the alloc and stream its NDJSON response through."""
+        import urllib.error
+        import urllib.request
+
+        server = self.agent.server
+        if server is None:
+            raise HTTPError(404, f"allocation {alloc_id} not on this agent")
+        alloc = server.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise HTTPError(404, f"unknown allocation {alloc_id}")
+        from ..state.matrix import node_attributes
+
+        node = server.store.node_by_id(alloc.node_id)
+        addr = (
+            node_attributes(node).get("nomad.advertise.address", "")
+            if node is not None else ""
+        )
+        if not addr or addr == self.addr:
+            raise HTTPError(
+                404, f"allocation {alloc_id} has no reachable node agent"
+            )
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["X-Nomad-Token"] = token
+        req = urllib.request.Request(
+            f"{addr}{path}", data=json.dumps(body).encode(),
+            method="POST", headers=headers,
+        )
+        try:
+            upstream = urllib.request.urlopen(req, timeout=330)
+        except urllib.error.HTTPError as exc:
+            raise HTTPError(exc.code, exc.read().decode(errors="replace"))
+        with upstream:
+            handler.send_response(upstream.status)
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            try:
+                while True:
+                    chunk = upstream.read1(65536)
+                    if not chunk:
+                        break
+                    handler.wfile.write(chunk)
+                    handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
 
     def _forward_client_fs(
         self, handler, path: str, query: Dict, alloc_id: str, token: str
@@ -713,6 +885,12 @@ class HTTPAPIServer:
                 updates = [serde.from_wire(w) for w in body["Allocs"]]
                 server.update_allocs_from_client(updates)
                 return {}
+            if path == "/v1/internal/node/volume-source":
+                return {"Source": server.get_volume_source(
+                    body.get("Namespace", "default"), body["VolumeID"]
+                )}
+            if path == "/v1/internal/node/alloc-fs-origin":
+                return server.get_alloc_fs_origin(body["AllocID"])
             raise HTTPError(404, f"unknown internal RPC {path}")
 
         if path == "/v1/jobs" and method == "GET":
@@ -732,7 +910,10 @@ class HTTPAPIServer:
             from ..acl import CAP_SUBMIT_JOB
 
             self._require_ns_cap(server, token, job.namespace, CAP_SUBMIT_JOB)
-            ev = server.submit_job(job)
+            try:
+                ev = server.submit_job(job)
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
             return {"EvalID": ev.id if ev else "", "JobModifyIndex":
                     store.job_by_id(job.namespace, job.id).modify_index}
         if path == "/v1/jobs/parse" and method == "POST":
@@ -741,21 +922,7 @@ class HTTPAPIServer:
                 raise HTTPError(400, "missing JobHCL")
             return _dump(parse_job(hcl))
 
-        m = re.match(r"^/v1/job/([^/]+)$", path)
-        if m:
-            ns = query.get("namespace", "default")
-            job = store.job_by_id(ns, m.group(1))
-            if method == "GET":
-                if job is None:
-                    raise HTTPError(404, "job not found")
-                return _dump(job)
-            if method == "DELETE":
-                purge = query.get("purge", "") in ("true", "1")
-                ev = server.deregister_job(ns, m.group(1), purge=purge)
-                if ev is None:
-                    raise HTTPError(404, "job not found")
-                return {"EvalID": ev.id}
-        m = re.match(r"^/v1/job/([^/]+)/plan$", path)
+        m = re.match(r"^/v1/job/(.+)/plan$", path)
         if m and method in ("PUT", "POST"):
             payload = (body or {}).get("Job", body)
             if payload is None:
@@ -769,15 +936,124 @@ class HTTPAPIServer:
             return server.plan_job(
                 job, diff=bool((body or {}).get("Diff", False))
             )
-        m = re.match(r"^/v1/job/([^/]+)/allocations$", path)
+        m = re.match(r"^/v1/job/(.+)/allocations$", path)
         if m and method == "GET":
             ns = query.get("namespace", "default")
             return _dump(store.allocs_by_job(ns, m.group(1)), exclude=("job",))
-        m = re.match(r"^/v1/job/([^/]+)/evaluations$", path)
+        m = re.match(r"^/v1/job/(.+)/evaluations$", path)
         if m and method == "GET":
             ns = query.get("namespace", "default")
             return _dump(store.evals_by_job(ns, m.group(1)))
-        m = re.match(r"^/v1/job/([^/]+)/summary$", path)
+        m = re.match(r"^/v1/job/(.+)/dispatch$", path)
+        if m and method in ("PUT", "POST"):
+            import base64
+
+            ns = query.get("namespace", "default")
+            from ..acl import CAP_DISPATCH_JOB
+
+            self._require_ns_cap(server, token, ns, CAP_DISPATCH_JOB)
+            try:
+                # binascii.Error (bad base64) subclasses ValueError.
+                payload = base64.b64decode(
+                    (body or {}).get("Payload", "") or ""
+                )
+                child, ev = server.dispatch_job(
+                    ns, m.group(1), payload, (body or {}).get("Meta") or {}
+                )
+            except ValueError as exc:
+                raise HTTPError(400, str(exc))
+            return {
+                "DispatchedJobID": child.id,
+                "EvalID": ev.id if ev else "",
+                "Index": store.latest_index,
+            }
+        m = re.match(r"^/v1/job/(.+)/versions$", path)
+        if m and method == "GET":
+            ns = query.get("namespace", "default")
+            versions = store.job_versions.get((ns, m.group(1)))
+            if not versions:
+                raise HTTPError(404, "job not found")
+            return {
+                "Versions": [_dump(v) for v in reversed(versions)],
+            }
+        m = re.match(r"^/v1/job/(.+)/revert$", path)
+        if m and method in ("PUT", "POST"):
+            ns = (body or {}).get("Namespace", query.get("namespace", "default"))
+            from ..acl import CAP_SUBMIT_JOB
+
+            self._require_ns_cap(server, token, ns, CAP_SUBMIT_JOB)
+            to_version = (body or {}).get("JobVersion")
+            ev = server.revert_job(
+                ns, m.group(1),
+                int(to_version) if to_version is not None else None,
+            )
+            if ev is None:
+                raise HTTPError(404, "job or target version not found")
+            return {"EvalID": ev.id, "JobModifyIndex": store.latest_index}
+        m = re.match(r"^/v1/job/(.+)/scale$", path)
+        if m:
+            ns = query.get("namespace", "default")
+            if method == "GET":
+                # Job.ScaleStatus: per-group counts + events.
+                job = store.job_by_id(ns, m.group(1))
+                if job is None:
+                    raise HTTPError(404, "job not found")
+                groups = {}
+                job_allocs = store.allocs_by_job(ns, job.id)
+                for tg in job.task_groups:
+                    running = sum(
+                        1 for a in job_allocs
+                        if a.task_group == tg.name
+                        and not a.terminal_status()
+                    )
+                    groups[tg.name] = {
+                        "Desired": tg.count,
+                        "Running": running,
+                        "Events": [
+                            _dump(e) for e in reversed(
+                                store.scaling_events.get(
+                                    (ns, job.id, tg.name), []
+                                )
+                            )
+                        ],
+                    }
+                return {"JobID": job.id, "JobStopped": job.stop,
+                        "TaskGroups": groups}
+            if method in ("PUT", "POST"):
+                ns = (body or {}).get("Namespace", ns)
+                from ..acl import CAP_SCALE_JOB
+
+                self._require_ns_cap(server, token, ns, CAP_SCALE_JOB)
+                target = (body or {}).get("Target") or {}
+                group = target.get("Group", "")
+                count = (body or {}).get("Count")
+                try:
+                    ev = server.scale_job(
+                        ns, m.group(1), group,
+                        int(count) if count is not None else None,
+                        message=(body or {}).get("Message", ""),
+                        error=bool((body or {}).get("Error", False)),
+                        meta=(body or {}).get("Meta") or {},
+                    )
+                except ValueError as exc:
+                    raise HTTPError(400, str(exc))
+                return {"EvalID": ev.id if ev else "",
+                        "Index": store.latest_index}
+        m = re.match(r"^/v1/job/(.+)/deployments$", path)
+        if m and method == "GET":
+            ns = query.get("namespace", "default")
+            deps = [
+                d for d in store.deployments.values()
+                if d.namespace == ns and d.job_id == m.group(1)
+            ]
+            deps.sort(key=lambda d: d.create_index, reverse=True)
+            return _dump(deps)
+        m = re.match(r"^/v1/job/(.+)/deployment$", path)
+        if m and method == "GET":
+            ns = query.get("namespace", "default")
+            dep = store.latest_deployment_by_job(ns, m.group(1))
+            return _dump(dep)
+        m = re.match(r"^/v1/job/(.+)/summary$", path)
         if m and method == "GET":
             ns = query.get("namespace", "default")
             summary = store.job_summaries.get((ns, m.group(1)))
@@ -788,6 +1064,22 @@ class HTTPAPIServer:
                 "Namespace": summary.namespace,
                 "Summary": summary.summary,
             }
+        # Bare job lookup LAST: the greedy id capture would otherwise
+        # swallow the suffixed routes above.
+        m = re.match(r"^/v1/job/(.+)$", path)
+        if m:
+            ns = query.get("namespace", "default")
+            job = store.job_by_id(ns, m.group(1))
+            if method == "GET":
+                if job is None:
+                    raise HTTPError(404, "job not found")
+                return _dump(job)
+            if method == "DELETE":
+                purge = query.get("purge", "") in ("true", "1")
+                ev = server.deregister_job(ns, m.group(1), purge=purge)
+                if ev is None:
+                    raise HTTPError(404, "job not found")
+                return {"EvalID": ev.id}
 
         if path == "/v1/nodes" and method == "GET":
             return [
@@ -871,11 +1163,191 @@ class HTTPAPIServer:
                 raise HTTPError(404, "alloc not found")
             return {"EvalID": ev.id}
 
+        # ---- deployments (nomad/deployment_endpoint.go: List :446,
+        # Promote :118, Fail, Pause) ----
+        if path == "/v1/deployments" and method == "GET":
+            ns = query.get("namespace", "default")
+            prefix = query.get("prefix", "")
+            deps = [
+                d for d in store.deployments.values()
+                if d.namespace == ns and d.id.startswith(prefix)
+            ]
+            deps.sort(key=lambda d: d.create_index, reverse=True)
+            return _dump(deps)
+        m = re.match(r"^/v1/deployment/([^/]+)$", path)
+        if m and method == "GET":
+            dep = store.deployment_by_id(m.group(1))
+            if dep is None:
+                raise HTTPError(404, "deployment not found")
+            from ..acl import CAP_READ_JOB
+
+            self._require_ns_cap(server, token, dep.namespace, CAP_READ_JOB)
+            return _dump(dep)
+        m = re.match(r"^/v1/deployment/([^/]+)/allocations$", path)
+        if m and method == "GET":
+            dep = store.deployment_by_id(m.group(1))
+            if dep is None:
+                raise HTTPError(404, "deployment not found")
+            from ..acl import CAP_READ_JOB
+
+            self._require_ns_cap(server, token, dep.namespace, CAP_READ_JOB)
+            return _dump([
+                a for a in store.allocs.values()
+                if a.deployment_id == dep.id
+            ], exclude=("job",))
+        m = re.match(r"^/v1/deployment/([^/]+)/(promote|fail|pause)$", path)
+        if m and method in ("PUT", "POST"):
+            dep = store.deployment_by_id(m.group(1))
+            if dep is None:
+                raise HTTPError(404, "deployment not found")
+            from ..acl import CAP_SUBMIT_JOB
+
+            self._require_ns_cap(server, token, dep.namespace, CAP_SUBMIT_JOB)
+            verb = m.group(2)
+            if not dep.active():
+                raise HTTPError(
+                    400, f"cannot {verb} a terminal deployment "
+                    f"({dep.status})"
+                )
+            if verb == "promote":
+                groups = (body or {}).get("Groups")
+                if (body or {}).get("All") or not groups:
+                    groups = None  # promote every canary group
+                if not dep.requires_promotion():
+                    raise HTTPError(400, "deployment has no canaries to promote")
+                server.promote_deployment(dep.id, groups)
+            elif verb == "fail":
+                server.fail_deployment(
+                    dep.id, "Deployment marked as failed by operator"
+                )
+            else:
+                server.pause_deployment(
+                    dep.id, bool((body or {}).get("Pause", True))
+                )
+            return {"DeploymentModifyIndex": store.latest_index,
+                    "Index": store.latest_index}
+
+        # ---- volumes (nomad/csi_endpoint.go trimmed to the plugin-less
+        # registered-volume analog) ----
+        if path == "/v1/volumes":
+            ns = query.get("namespace", "default")
+            if method == "GET":
+                return _dump(sorted(
+                    (v for (vns, _), v in store.volumes.items()
+                     if vns == ns),
+                    key=lambda v: v.id,
+                ))
+            if method in ("PUT", "POST"):
+                from ..structs.types import Volume
+
+                spec = (body or {}).get("Volume", body) or {}
+                vol = Volume(
+                    id=spec.get("ID", spec.get("id", "")),
+                    name=spec.get("Name", spec.get("name", "")),
+                    namespace=spec.get(
+                        "Namespace", spec.get("namespace", ns)
+                    ),
+                    source=spec.get("Source", spec.get("source", "")),
+                    access_mode=spec.get(
+                        "AccessMode",
+                        spec.get("access_mode", "single-node-writer"),
+                    ),
+                    attachment_mode=spec.get(
+                        "AttachmentMode",
+                        spec.get("attachment_mode", "file-system"),
+                    ),
+                    capacity_mb=int(spec.get(
+                        "CapacityMB", spec.get("capacity_mb", 0)
+                    )),
+                )
+                from ..acl import CAP_SUBMIT_JOB
+
+                self._require_ns_cap(
+                    server, token, vol.namespace, CAP_SUBMIT_JOB
+                )
+                store.upsert_volume(server.next_index(), vol)
+                return {"ID": vol.id, "Index": store.latest_index}
+        m = re.match(r"^/v1/volume/([^/]+)$", path)
+        if m:
+            ns = query.get("namespace", "default")
+            vol = store.volume_by_id(ns, m.group(1))
+            if vol is None:
+                raise HTTPError(404, "volume not found")
+            if method == "GET":
+                return _dump(vol)
+            if method == "DELETE":
+                from ..acl import CAP_SUBMIT_JOB
+
+                self._require_ns_cap(
+                    server, token, vol.namespace, CAP_SUBMIT_JOB
+                )
+                try:
+                    store.delete_volume(server.next_index(), ns, m.group(1))
+                except ValueError as exc:
+                    raise HTTPError(409, str(exc))
+                return {}
+
+        # ---- scaling policies (nomad/scaling_endpoint.go) ----
+        if path == "/v1/scaling/policies" and method == "GET":
+            ns = query.get("namespace", "default")
+            return [
+                {
+                    "Namespace": pns, "JobID": jid, "Group": group,
+                    "Policy": _dump(pol),
+                }
+                for (pns, jid, group), pol in sorted(
+                    store.scaling_policies.items()
+                )
+                if pns == ns
+            ]
+
+        # ---- system (nomad/system_endpoint.go) ----
+        if path == "/v1/system/gc" and method in ("PUT", "POST"):
+            server.system_gc()
+            return {}
+
+        # ---- membership (nomad/serf.go join; operator_endpoint.go
+        # RaftRemovePeer) ----
+        if path == "/v1/operator/raft/join" and method in ("PUT", "POST"):
+            addr = (body or {}).get("Addr", "")
+            if not addr:
+                raise HTTPError(400, "missing Addr")
+            try:
+                return {"Members": server.join_peer(addr)}
+            except ValueError as exc:
+                raise HTTPError(501, str(exc))
+        if path == "/v1/operator/raft/remove-peer" and method in (
+            "PUT", "POST"
+        ):
+            addr = (body or {}).get("Addr", "")
+            if not addr:
+                raise HTTPError(400, "missing Addr")
+            try:
+                return {"Members": server.remove_peer(addr)}
+            except ValueError as exc:
+                raise HTTPError(501, str(exc))
+
         if path == "/v1/status/leader" and method == "GET":
             rep = store.replicator
             return rep.leader_addr if rep is not None else self.agent.rpc_addr
         if path == "/v1/agent/members" and method == "GET":
-            return {"Members": [self.agent.member_info()]}
+            members = [self.agent.member_info()]
+            rep = store.replicator
+            if rep is not None:
+                st = rep.stats()
+                members[0]["Leader"] = rep.is_leader
+                members[0]["RaftTerm"] = st["Term"]
+                for addr, pst in st["Peers"].items():
+                    members.append({
+                        "Name": addr,
+                        "Addr": addr,
+                        "Server": True,
+                        "Status": "alive" if pst["Healthy"] else "failed",
+                        "Leader": addr == st["LeaderAddr"],
+                        "LastError": pst["LastError"],
+                    })
+                return {"Members": members, "Leader": st["LeaderAddr"]}
+            return {"Members": members}
         if path == "/v1/agent/self" and method == "GET":
             return self.agent.member_info()
         if path == "/v1/agent/profile" and method == "GET":

@@ -121,6 +121,17 @@ class HTTPServerRPC:
         })
         return bool(out.get("Allowed"))
 
+    def get_volume_source(self, namespace: str, volume_id: str):
+        out = self._call("/v1/internal/node/volume-source", {
+            "Namespace": namespace, "VolumeID": volume_id,
+        })
+        return out.get("Source")
+
+    def get_alloc_fs_origin(self, alloc_id: str):
+        return self._call("/v1/internal/node/alloc-fs-origin", {
+            "AllocID": alloc_id,
+        })
+
 
 # The hint travels inside a JSON error body — stop before quote/brace.
 _LEADER_HINT = re.compile(r"leader=([^\s\"'}]+)")
@@ -139,6 +150,7 @@ class FailoverRPC:
 
     def __init__(self, addrs: List[str], timeout: float = 10.0, token: str = ""):
         assert addrs, "need at least one server address"
+        self.token = token
         self.rpcs = {
             a: HTTPServerRPC(a, timeout=timeout, token=token) for a in addrs
         }
@@ -187,3 +199,9 @@ class FailoverRPC:
 
     def check_acl_capability(self, *args, **kwargs) -> bool:
         return self._with_failover("check_acl_capability", *args, **kwargs)
+
+    def get_volume_source(self, *args, **kwargs):
+        return self._with_failover("get_volume_source", *args, **kwargs)
+
+    def get_alloc_fs_origin(self, *args, **kwargs):
+        return self._with_failover("get_alloc_fs_origin", *args, **kwargs)

@@ -53,6 +53,12 @@ def cmd_agent(args) -> int:
         config.http_port = args.port
     if args.workers is not None:
         config.server_config.num_workers = args.workers
+    if args.raft:
+        config.server_config.raft_enabled = True
+    if args.peers is not None:
+        config.server_config.peers = [
+            a for a in args.peers.split(",") if a
+        ]
     if args.data_dir:
         config.server_config.data_dir = args.data_dir
     agent = Agent(config)
@@ -225,7 +231,7 @@ def cmd_node_eligibility(args) -> int:
 
 def cmd_alloc_status(args) -> int:
     client = _client(args)
-    alloc = client.get_allocation(args.alloc_id)
+    alloc = client.get_allocation(_resolve_alloc_id(client, args.alloc_id))
     keep = (
         "id", "name", "node_id", "job_id", "task_group", "desired_status",
         "client_status", "create_time",
@@ -249,6 +255,7 @@ def cmd_alloc_logs(args) -> int:
     import urllib.parse
     import urllib.request
 
+    args.alloc_id = _resolve_alloc_id(_client(args), args.alloc_id)
     task = args.task
     if not task:
         alloc = _client(args).get_allocation(args.alloc_id)
@@ -312,6 +319,58 @@ def cmd_alloc_fs(args) -> int:
     return 1
 
 
+def _resolve_alloc_id(client: APIClient, prefix: str) -> str:
+    """Expand a short alloc id the way the reference CLI does (prefix
+    search, command/meta.go resolution)."""
+    if len(prefix) >= 36:
+        return prefix
+    try:
+        out = client.search(prefix, context="allocs")
+        hits = out.get("Matches", {}).get("allocs", [])
+    except APIError:
+        return prefix
+    if len(hits) == 1:
+        return hits[0]
+    if len(hits) > 1:
+        print(f"alloc id prefix {prefix!r} is ambiguous: {hits}",
+              file=sys.stderr)
+    return prefix
+
+
+def cmd_alloc_exec(args) -> int:
+    """Run a command inside a task's context (`nomad alloc exec`,
+    command/alloc_exec.go; stdin is read upfront when piped)."""
+    stdin = b""
+    try:
+        if not sys.stdin.isatty():
+            stdin = sys.stdin.buffer.read()
+    except (OSError, ValueError):
+        pass  # no usable stdin (test harness)
+    cmd = list(args.cmd or [])
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]  # only the leading separator; inner "--" is argv
+    if not cmd:
+        print("usage: alloc exec <alloc_id> [--task t] -- cmd args...",
+              file=sys.stderr)
+        return 1
+    client = _client(args)
+    alloc_id = _resolve_alloc_id(client, args.alloc_id)
+    try:
+        code, out, err = client.alloc_exec(
+            alloc_id, args.task, cmd, stdin=stdin,
+        )
+    except APIError as exc:
+        print(f"exec failed: {exc}", file=sys.stderr)
+        return 1
+    if out:
+        sys.stdout.buffer.write(out)
+        sys.stdout.flush()
+    if err:
+        sys.stderr.buffer.write(err)
+        sys.stderr.flush()
+    return code if code >= 0 else 1
+
+
 def cmd_acl(args) -> int:
     """ACL admin (reference: `nomad acl bootstrap/policy/token`)."""
     client = _client(args)
@@ -373,6 +432,143 @@ def cmd_search(args) -> int:
     return 0
 
 
+def cmd_job_dispatch(args) -> int:
+    client = _client(args)
+    payload = b""
+    if args.payload_file:
+        with open(args.payload_file, "rb") as fh:
+            payload = fh.read()
+    for kv in args.meta or []:
+        if "=" not in kv:
+            print(f"-meta expects KEY=VALUE, got {kv!r}", file=sys.stderr)
+            return 1
+    meta = dict(kv.split("=", 1) for kv in args.meta or [])
+    out = client.dispatch_job(
+        args.job_id, payload, meta, namespace=args.namespace
+    )
+    print(f"Dispatched Job ID = {out['DispatchedJobID']}")
+    print(f"Evaluation ID     = {out.get('EvalID', '')}")
+    return 0
+
+
+def cmd_job_history(args) -> int:
+    client = _client(args)
+    out = client.job_versions(args.job_id, namespace=args.namespace)
+    for v in out["Versions"]:
+        print(
+            f"Version {v['version']:4}  submitted "
+            f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(v['submit_time']))}"
+            f"{'  (stopped)' if v['stop'] else ''}"
+        )
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    client = _client(args)
+    out = client.revert_job(
+        args.job_id, args.version, namespace=args.namespace
+    )
+    print(f"Reverted; eval {out.get('EvalID', '')}")
+    return 0
+
+
+def cmd_job_scale(args) -> int:
+    client = _client(args)
+    # `job scale <job> <count>` shorthand (single-group jobs): the count
+    # binds to the optional group positional — reinterpret it.
+    if args.count is None and args.group.lstrip("-").isdigit():
+        args.count = int(args.group)
+        args.group = ""
+    if args.count is None:
+        _print(client.job_scale_status(args.job_id, namespace=args.namespace))
+        return 0
+    out = client.scale_job(
+        args.job_id, args.group, args.count,
+        message=args.message, namespace=args.namespace,
+    )
+    print(f"Scaled {args.job_id}/{args.group} to {args.count}; "
+          f"eval {out.get('EvalID', '')}")
+    return 0
+
+
+def _resolve_deployment_id(client: APIClient, prefix: str) -> str:
+    if len(prefix) >= 36:
+        return prefix
+    try:
+        out = client.search(prefix, context="deployment")
+        hits = out.get("Matches", {}).get("deployment", [])
+    except APIError:
+        return prefix
+    return hits[0] if len(hits) == 1 else prefix
+
+
+def cmd_deployment(args) -> int:
+    client = _client(args)
+    action = args.deployment_action
+    if getattr(args, "deployment_id", ""):
+        args.deployment_id = _resolve_deployment_id(
+            client, args.deployment_id
+        )
+    if action == "list":
+        for d in client.list_deployments(namespace=args.namespace):
+            print(
+                f"{d['id'][:8]} job={d['job_id']:24} v{d['job_version']} "
+                f"{d['status']:10} {d['status_description']}"
+            )
+        return 0
+    if action == "status":
+        _print(client.get_deployment(args.deployment_id))
+        return 0
+    if action == "promote":
+        out = client.promote_deployment(
+            args.deployment_id, args.group or None
+        )
+        print(f"Promoted; index {out.get('Index')}")
+        return 0
+    if action == "fail":
+        client.fail_deployment(args.deployment_id)
+        print("Deployment marked failed")
+        return 0
+    if action == "pause":
+        client.pause_deployment(args.deployment_id, not args.resume)
+        print("Deployment " + ("resumed" if args.resume else "paused"))
+        return 0
+    return 1
+
+
+def cmd_volume(args) -> int:
+    client = _client(args)
+    action = args.volume_action
+    if action == "list":
+        for v in client.list_volumes(namespace=args.namespace):
+            writers = len(v["write_claims"])
+            readers = len(v["read_claims"])
+            print(
+                f"{v['id']:36} {v['access_mode']:24} "
+                f"claims: {writers}w/{readers}r"
+            )
+        return 0
+    if action == "register":
+        spec = json.loads(open(args.volume_file).read())
+        out = client.register_volume(spec, namespace=args.namespace)
+        print(f"Registered volume {out['ID']}")
+        return 0
+    if action == "status":
+        _print(client.get_volume(args.volume_id, namespace=args.namespace))
+        return 0
+    if action == "deregister":
+        client.deregister_volume(args.volume_id, namespace=args.namespace)
+        print("Deregistered")
+        return 0
+    return 1
+
+
+def cmd_system_gc(args) -> int:
+    _client(args).system_gc()
+    print("GC triggered")
+    return 0
+
+
 def cmd_eval_status(args) -> int:
     client = _client(args)
     _print(client.get_evaluation(args.eval_id))
@@ -381,6 +577,22 @@ def cmd_eval_status(args) -> int:
 
 def cmd_server_members(args) -> int:
     _print(_client(args).members())
+    return 0
+
+
+def cmd_server_join(args) -> int:
+    out = _client(args).server_join(args.peer_addr)
+    print("Members:")
+    for m in out["Members"]:
+        print(f"  {m}")
+    return 0
+
+
+def cmd_server_remove_peer(args) -> int:
+    out = _client(args).server_remove_peer(args.peer_addr)
+    print("Members:")
+    for m in out["Members"]:
+        print(f"  {m}")
     return 0
 
 
@@ -418,6 +630,11 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument("--bind", default=None)
     agent.add_argument("--port", type=int, default=None)
     agent.add_argument("--workers", type=int, default=None)
+    agent.add_argument("--raft", action="store_true", default=False,
+                       help="run replication even with no peers "
+                            "(single server that grows via `server join`)")
+    agent.add_argument("--peers", default=None,
+                       help="comma-separated peer server HTTP addrs")
     agent.add_argument("--server-only", action="store_true")
     agent.add_argument("--client-only", action="store_true")
     agent.add_argument("--servers", default=None,
@@ -450,6 +667,64 @@ def build_parser() -> argparse.ArgumentParser:
     parse = job.add_parser("parse")
     parse.add_argument("jobfile")
     parse.set_defaults(fn=cmd_job_parse)
+    dispatch = job.add_parser("dispatch")
+    dispatch.add_argument("job_id")
+    dispatch.add_argument("payload_file", nargs="?", default="")
+    dispatch.add_argument("-meta", action="append", metavar="KEY=VALUE")
+    dispatch.add_argument("--namespace", default="default")
+    dispatch.set_defaults(fn=cmd_job_dispatch)
+    history = job.add_parser("history")
+    history.add_argument("job_id")
+    history.add_argument("--namespace", default="default")
+    history.set_defaults(fn=cmd_job_history)
+    revert = job.add_parser("revert")
+    revert.add_argument("job_id")
+    revert.add_argument("version", nargs="?", type=int, default=None)
+    revert.add_argument("--namespace", default="default")
+    revert.set_defaults(fn=cmd_job_revert)
+    scale = job.add_parser("scale")
+    scale.add_argument("job_id")
+    scale.add_argument("group", nargs="?", default="")
+    scale.add_argument("count", nargs="?", type=int, default=None)
+    scale.add_argument("--message", default="")
+    scale.add_argument("--namespace", default="default")
+    scale.set_defaults(fn=cmd_job_scale)
+
+    dep = sub.add_parser("deployment", help="deployment ops").add_subparsers(
+        dest="deployment_action", required=True
+    )
+    dlist = dep.add_parser("list")
+    dlist.add_argument("--namespace", default="default")
+    dlist.set_defaults(fn=cmd_deployment)
+    for verb in ("status", "promote", "fail", "pause"):
+        dp = dep.add_parser(verb)
+        dp.add_argument("deployment_id")
+        if verb == "promote":
+            dp.add_argument("-group", action="append", default=[])
+        if verb == "pause":
+            dp.add_argument("-resume", action="store_true")
+        dp.set_defaults(fn=cmd_deployment)
+
+    system = sub.add_parser("system", help="system ops").add_subparsers(
+        dest="system_cmd", required=True
+    )
+    system.add_parser("gc").set_defaults(fn=cmd_system_gc)
+
+    vol = sub.add_parser("volume", help="volume ops").add_subparsers(
+        dest="volume_action", required=True
+    )
+    vlist = vol.add_parser("list")
+    vlist.add_argument("--namespace", default="default")
+    vlist.set_defaults(fn=cmd_volume)
+    vreg = vol.add_parser("register")
+    vreg.add_argument("volume_file")
+    vreg.add_argument("--namespace", default="default")
+    vreg.set_defaults(fn=cmd_volume)
+    for verb in ("status", "deregister"):
+        vp = vol.add_parser(verb)
+        vp.add_argument("volume_id")
+        vp.add_argument("--namespace", default="default")
+        vp.set_defaults(fn=cmd_volume)
 
     node = sub.add_parser("node", help="node operations").add_subparsers(
         dest="node_cmd", required=True
@@ -485,6 +760,11 @@ def build_parser() -> argparse.ArgumentParser:
                        dest="tail_bytes")
     alogs.set_defaults(fn=cmd_alloc_logs)
 
+    aexec = alloc.add_parser("exec")
+    aexec.add_argument("alloc_id")
+    aexec.add_argument("--task", default="")
+    aexec.add_argument("cmd", nargs=argparse.REMAINDER)
+    aexec.set_defaults(fn=cmd_alloc_exec)
     afs = alloc.add_parser("fs")
     afs.add_argument("alloc_id")
     afs.add_argument("path", nargs="?", default="")
@@ -537,6 +817,12 @@ def build_parser() -> argparse.ArgumentParser:
         dest="server_cmd", required=True
     )
     sm.add_parser("members").set_defaults(fn=cmd_server_members)
+    sjoin = sm.add_parser("join")
+    sjoin.add_argument("peer_addr")
+    sjoin.set_defaults(fn=cmd_server_join)
+    srm = sm.add_parser("remove-peer")
+    srm.add_argument("peer_addr")
+    srm.set_defaults(fn=cmd_server_remove_peer)
 
     op = sub.add_parser("operator", help="operator ops").add_subparsers(
         dest="operator_cmd", required=True

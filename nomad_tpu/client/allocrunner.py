@@ -38,12 +38,20 @@ class AllocRunner:
         node=None,
         wait_for_prev_terminal: Optional[Callable[[str, float], bool]] = None,
         artifact_root: str = "",
+        resolve_volume_source: Optional[Callable[[str, str], Optional[str]]] = None,
+        alloc_fs_origin: Optional[Callable[[str], dict]] = None,
+        fetch_token: str = "",
     ):
         self.alloc = alloc
         self.drivers = drivers
         self.on_alloc_update = on_alloc_update
         self.node = node
         self.artifact_root = artifact_root  # for ${attr.*}/${node.*} interpolation
+        self.resolve_volume_source = resolve_volume_source
+        self.alloc_fs_origin = alloc_fs_origin
+        # ACL secret the agent presents on cross-node FS fetches (remote
+        # disk migration); the client's own RPC token.
+        self.fetch_token = fetch_token
         # Gate for disk migration: blocks until the replaced alloc stops
         # writing (client/allocwatcher prevAllocWatcher.Wait).
         self.wait_for_prev_terminal = wait_for_prev_terminal
@@ -114,6 +122,9 @@ class AllocRunner:
                 restart_policy=restart or tg.restart_policy,
                 on_state_change=self._on_task_state,
                 artifact_root=self.artifact_root,
+                dispatch_payload=getattr(self.alloc.job, "payload", "")
+                if self.alloc.job else "",
+                volume_mounts=self._resolve_volume_mounts(tg, task),
             )
             with self._lock:
                 self.runners[task.name] = tr
@@ -148,14 +159,175 @@ class AllocRunner:
                 launch(t).wait()
         self._finalize()
 
+    # Total bytes fetched per remote disk migration (the reference caps by
+    # ephemeral_disk size; a runaway prev-alloc dir must not fill this
+    # node's disk).
+    REMOTE_MIGRATE_CAP = 256 * 1024 * 1024
+
+    def _migrate_remote_disk(self, tg) -> None:
+        """Fetch the previous alloc's ``alloc/`` + per-task ``local/`` dirs
+        from the node that ran it, via that agent's FS API.  Gated on the
+        previous alloc being terminal (poll the server), size-capped.
+        With ACLs enabled the remote agent enforces read-fs; the fetch
+        presents this client's RPC token (``fetch_token``)."""
+        import json as _json
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        prev_id = self.alloc.previous_allocation
+        origin_fn = self.alloc_fs_origin
+        if origin_fn is None:
+            return
+        headers = (
+            {"X-Nomad-Token": self.fetch_token} if self.fetch_token else {}
+        )
+
+        def _open(url: str, timeout: float):
+            return urllib.request.urlopen(
+                urllib.request.Request(url, headers=headers),
+                timeout=timeout,
+            )
+        deadline = time.time() + 60.0
+        addr = ""
+        while time.time() < deadline:
+            try:
+                origin = origin_fn(prev_id)
+            except Exception:  # noqa: BLE001 — server briefly unreachable
+                time.sleep(1.0)
+                continue
+            addr = origin.get("Addr", "")
+            if not addr:
+                return  # origin node unknown/gone; nothing to fetch
+            if origin.get("Terminal"):
+                break
+            time.sleep(0.5)
+        else:
+            log.warning(
+                "previous alloc %s not terminal after 60s; skipping remote "
+                "disk migration", prev_id[:8],
+            )
+            return
+
+        budget = [self.REMOTE_MIGRATE_CAP]
+
+        alloc_root = os.path.realpath(self.alloc_dir)
+
+        def fetch(rel: str, dst_rel: str, depth: int = 0) -> None:
+            if depth > 16:
+                return
+            qs = urllib.parse.urlencode({"path": rel})
+            with _open(
+                f"{addr}/v1/client/fs/ls/{prev_id}?{qs}", timeout=60
+            ) as resp:
+                entries = _json.loads(resp.read())
+            for e in entries:
+                name = e["Name"]
+                # Entry names come from another agent: refuse anything
+                # that is not a plain component (a compromised origin
+                # must not steer writes outside the alloc dir).
+                if not name or "/" in name or name in (".", ".."):
+                    continue
+                sub = f"{rel}/{name}" if rel else name
+                dst = os.path.join(self.alloc_dir, dst_rel, name)
+                real = os.path.realpath(dst)
+                if real != alloc_root and not real.startswith(
+                    alloc_root + os.sep
+                ):
+                    continue
+                if e["IsDir"]:
+                    os.makedirs(dst, exist_ok=True)
+                    fetch(sub, os.path.join(dst_rel, name), depth + 1)
+                    continue
+                size = int(e.get("Size", 0))
+                if budget[0] - size < 0:
+                    raise RuntimeError("remote migration size cap exceeded")
+                budget[0] -= size
+                q2 = urllib.parse.urlencode({
+                    "path": sub, "limit": str(max(size, 1)),
+                })
+                with _open(
+                    f"{addr}/v1/client/fs/cat/{prev_id}?{q2}", timeout=300
+                ) as resp, open(dst, "wb") as out:
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        out.write(chunk)
+
+        fetched = []
+        for rel in ["alloc"] + [
+            os.path.join(t.name, "local") for t in (tg.tasks if tg else [])
+        ]:
+            try:
+                os.makedirs(
+                    os.path.join(self.alloc_dir, rel), exist_ok=True
+                )
+                fetch(rel, rel)
+                fetched.append(rel)
+            except urllib.error.HTTPError as exc:
+                if exc.code != 404:  # absent dir on origin: fine
+                    log.warning(
+                        "remote disk migration of %s failed: %s", rel, exc
+                    )
+            except Exception as exc:  # noqa: BLE001 — best-effort carry
+                log.warning(
+                    "remote disk migration of %s failed: %s", rel, exc
+                )
+        if fetched:
+            log.info(
+                "migrated ephemeral disk of %s from %s (%s)",
+                prev_id[:8], addr, ", ".join(fetched),
+            )
+
+    def _resolve_volume_mounts(self, tg, task) -> list:
+        """(host_path, destination, read_only) triples for the task's
+        volume_mount blocks (the volume hook, alloc_runner_hooks.go +
+        taskrunner volume_hook.go): group ``volume`` asks resolve against
+        the node's host_volumes map.  Registered ("csi") volumes resolve by
+        their source name — the backing host volume the server's
+        feasibility check already required this node to expose."""
+        mounts = []
+        if tg is None or self.node is None:
+            return mounts
+        host_vols = getattr(self.node, "host_volumes", None) or {}
+        for vm in getattr(task, "volume_mounts", None) or []:
+            vreq = (tg.volumes or {}).get(vm.volume)
+            if vreq is None:
+                continue
+            src_name = vreq.source or vreq.name
+            if vreq.type == "csi" and self.resolve_volume_source is not None:
+                # Registered volume: its id maps to a backing host-volume
+                # name only the server's volume table knows.
+                try:
+                    src_name = self.resolve_volume_source(
+                        self.alloc.namespace, vreq.source
+                    ) or src_name
+                except Exception:  # noqa: BLE001 — fall back to the name
+                    pass
+            host_path = host_vols.get(src_name) or host_vols.get(vreq.name)
+            if not host_path:
+                log.warning(
+                    "volume %r: host volume %r not on node; mount skipped",
+                    vm.volume, src_name,
+                )
+                continue
+            mounts.append((
+                host_path,
+                vm.destination or vm.volume,
+                vm.read_only or vreq.read_only,
+            ))
+        return mounts
+
     def _migrate_previous_disk(self) -> None:
         """Ephemeral-disk sticky/migrate data movement (the
-        client/allocwatcher/ + prevAllocMigrator seam, trimmed to the
-        same-agent case): when the replaced alloc's dir is still on this
-        agent, carry its shared ``alloc/`` dir and each task's ``local/``
-        dir into the new alloc.  The scheduler's sticky preference
-        (findPreferredNode) makes same-node the common case; cross-node
-        migration (the reference streams via the FS API) is not attempted.
+        client/allocwatcher/ + prevAllocMigrator seam): when the replaced
+        alloc's dir is still on this agent, carry its shared ``alloc/``
+        dir and each task's ``local/`` dir into the new alloc.  When it
+        lived on ANOTHER node and the group sets ``migrate``, the data is
+        fetched over the FS API from that node's agent (the reference's
+        remote prevAllocMigrator streams through the same surface,
+        client/allocwatcher/alloc_watcher.go).
         """
         import shutil
 
@@ -170,7 +342,9 @@ class AllocRunner:
             os.path.dirname(self.alloc_dir), self.alloc.previous_allocation
         )
         if not os.path.isdir(prev_dir):
-            return  # previous alloc lived on another node
+            if disk.migrate:
+                self._migrate_remote_disk(tg)
+            return
         # Copying while the old task still writes would inherit torn data:
         # wait for the replaced alloc to reach a terminal state first
         # (prevAllocWatcher.Wait semantics).
@@ -278,6 +452,8 @@ class AllocRunner:
                     restart_policy=restart,
                     on_state_change=self._on_task_state,
                     artifact_root=self.artifact_root,
+                    dispatch_payload=getattr(self.alloc.job, "payload", "")
+                    if self.alloc.job else "",
                 )
                 with self._lock:
                     self.runners[task.name] = tr

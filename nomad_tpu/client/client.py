@@ -60,6 +60,10 @@ class ClientConfig:
     # Host path local (file://) artifact sources may read from; empty =
     # file sources restricted to the task dir (exfiltration sandbox).
     artifact_root: str = ""
+    # Host volumes this node exposes (client host_volume config blocks):
+    # name -> host path.  Feasibility (HostVolumeChecker) and the volume
+    # mount hook resolve against these.
+    host_volumes: Dict[str, str] = field(default_factory=dict)
 
 
 class Client:
@@ -95,6 +99,8 @@ class Client:
             },
             status=NodeStatus.INIT.value,
         )
+        if self.config.host_volumes:
+            self.node.host_volumes = dict(self.config.host_volumes)
         # A restarted agent MUST come back as the same node or its allocs
         # would be orphaned server-side.
         persisted_id = self.state_db.get_node_id()
@@ -109,6 +115,9 @@ class Client:
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
         self._ttl = 10.0
+        # When heartbeats began failing, or None while connected
+        # (heartbeat-stop policy, client/heartbeatstop.go).
+        self._disconnected_since: Optional[float] = None
 
     # ------------------------------------------------------------------
 
@@ -160,6 +169,13 @@ class Client:
                 node=self.node,
                 wait_for_prev_terminal=self._wait_prev_terminal,
                 artifact_root=self.config.artifact_root,
+                resolve_volume_source=getattr(
+                    self.server, "get_volume_source", None
+                ),
+                alloc_fs_origin=getattr(
+                    self.server, "get_alloc_fs_origin", None
+                ),
+                fetch_token=getattr(self.server, "token", ""),
             )
             with self._lock:
                 self.allocs[alloc.id] = ar
@@ -181,13 +197,55 @@ class Client:
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
-            wait = max(self._ttl * self.config.heartbeat_factor, 0.5)
+            if self._disconnected_since is not None:
+                # Disconnected: probe fast so reconnection (and the stop
+                # policy below) track real time, not the TTL cadence.
+                wait = 1.0
+            else:
+                # Cap the healthy cadence at 10s: the heartbeat doubles as
+                # the disconnect DETECTOR, and stop_after_client_disconnect
+                # windows must not wait out a long TTL before the first
+                # failure is even observed.
+                wait = min(
+                    max(self._ttl * self.config.heartbeat_factor, 0.5),
+                    10.0,
+                )
             if self._shutdown.wait(timeout=wait):
                 return
             try:
                 self._ttl = self.server.heartbeat_node(self.node.id) or self._ttl
+                self._disconnected_since = None
             except Exception:  # noqa: BLE001
-                log.exception("heartbeat failed")
+                if self._disconnected_since is None:
+                    self._disconnected_since = time.time()
+                    log.warning("heartbeat failed; servers unreachable",
+                                exc_info=True)
+            self._heartbeat_stop_check()
+
+    def _heartbeat_stop_check(self) -> None:
+        """Disconnected-client policy (client/heartbeatstop.go): a group
+        with ``stop_after_client_disconnect`` must not keep running
+        unsupervised once this agent has lost its servers for longer than
+        that window — the server has already marked the node down and
+        rescheduled; two copies would run."""
+        if self._disconnected_since is None:
+            return
+        disconnected_for = time.time() - self._disconnected_since
+        with self._lock:
+            runners = list(self.allocs.values())
+        for ar in runners:
+            job = ar.alloc.job
+            tg = job.lookup_task_group(ar.alloc.task_group) if job else None
+            window = tg.stop_after_client_disconnect if tg else None
+            if window is None or ar.terminal:
+                continue
+            if disconnected_for > window:
+                log.warning(
+                    "stopping alloc %s: servers unreachable %.1fs > "
+                    "stop_after_client_disconnect=%.1fs",
+                    ar.alloc.id[:8], disconnected_for, window,
+                )
+                ar.kill()
 
     # ------------------------------------------------------------------
 
@@ -231,6 +289,14 @@ class Client:
                     alloc, self.drivers, self.data_dir, self._alloc_updated,
                     node=self.node,
                     wait_for_prev_terminal=self._wait_prev_terminal,
+                    artifact_root=self.config.artifact_root,
+                    resolve_volume_source=getattr(
+                        self.server, "get_volume_source", None
+                    ),
+                    alloc_fs_origin=getattr(
+                        self.server, "get_alloc_fs_origin", None
+                    ),
+                    fetch_token=getattr(self.server, "token", ""),
                 )
                 with self._lock:
                     self.allocs[aid] = ar

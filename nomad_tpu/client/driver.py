@@ -405,12 +405,28 @@ class SidecarClient:
         os.makedirs(self.state_dir, exist_ok=True)
         import sys
 
+        # The native C++ supervisor (native/executor.cc) speaks the same
+        # protocol and is preferred when built; the Python sidecar is the
+        # always-available fallback.  NOMAD_TPU_EXECUTOR_BIN overrides
+        # (empty string forces Python).
+        native = os.environ.get("NOMAD_TPU_EXECUTOR_BIN")
+        if native is None:
+            candidate = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                )),
+                "native", "nomad-executor",
+            )
+            native = candidate if os.access(candidate, os.X_OK) else ""
+        if native:
+            cmd = [native, "--socket", self.sock_path,
+                   "--state-dir", self.state_dir]
+        else:
+            cmd = [sys.executable, "-m", "nomad_tpu.client.executor",
+                   "--socket", self.sock_path,
+                   "--state-dir", self.state_dir]
         self._proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "nomad_tpu.client.executor",
-                "--socket", self.sock_path,
-                "--state-dir", self.state_dir,
-            ],
+            cmd,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
             start_new_session=True,  # survives the agent

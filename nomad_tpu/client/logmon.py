@@ -1,0 +1,101 @@
+"""Logmon — size-capped task log rotation.
+
+Reference: ``client/logmon/`` (489 LoC) + ``logging/rotator.go``: a
+separate daemon pumps task output through a FIFO into ``N files × M
+bytes``.  Here the writers are non-cooperating child processes that keep
+their own O_APPEND file descriptors across agent AND sidecar restarts
+(that fd continuity is what makes task recovery work, client/driver.py
+RecoverTask) — so instead of interposing a pipe that would die with its
+pump, the runner rotates by **copy-truncate**: when the live file crosses
+the cap, its content shifts to ``<base>.1`` (… up to ``max_files - 1``,
+oldest dropped) and the live file truncates to zero.  O_APPEND writers
+continue seamlessly at the new EOF.  Bytes written during the copy window
+can be lost — the documented tradeoff for surviving supervisor loss,
+which the reference accepts at logmon-reattach the same way.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+from typing import List
+
+log = logging.getLogger(__name__)
+
+DEFAULT_MAX_FILE_BYTES = 10 * 1024 * 1024  # logs.max_file_size = 10 MB
+DEFAULT_MAX_FILES = 10  # logs.max_files
+CHECK_INTERVAL_S = 0.5
+
+
+def rotate_once(
+    path: str, max_files: int, max_bytes: int = 0
+) -> None:
+    """Shift ``path`` into the numbered history and truncate it.  When
+    ``max_bytes`` is set, the history copy keeps only the newest
+    ``max_bytes`` tail — a burst that outran a check interval must not
+    smuggle an oversized file into the history."""
+    # Drop the oldest, shift the rest up.
+    oldest = f"{path}.{max_files - 1}"
+    if max_files > 1 and os.path.exists(oldest):
+        os.unlink(oldest)
+    for i in range(max_files - 2, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i + 1}")
+    if max_files > 1:
+        size = os.path.getsize(path)
+        if max_bytes and size > max_bytes:
+            with open(path, "rb") as src, open(f"{path}.1", "wb") as dst:
+                src.seek(size - max_bytes)
+                shutil.copyfileobj(src, dst)
+        else:
+            shutil.copyfile(path, f"{path}.1")
+    # Truncate in place: the writer's O_APPEND fd continues at offset 0.
+    with open(path, "r+b") as fh:
+        fh.truncate(0)
+
+
+class LogRotator:
+    """Watches a task's stdout/stderr files and caps them in place."""
+
+    def __init__(
+        self,
+        paths: List[str],
+        max_file_bytes: int = DEFAULT_MAX_FILE_BYTES,
+        max_files: int = DEFAULT_MAX_FILES,
+        interval: float = CHECK_INTERVAL_S,
+    ):
+        self.paths = list(paths)
+        self.max_file_bytes = max(1024, int(max_file_bytes))
+        self.max_files = max(1, int(max_files))
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="logmon", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.check()  # final sweep so a burst right before exit is capped
+
+    def check(self) -> None:
+        for path in self.paths:
+            try:
+                if os.path.exists(path) and (
+                    os.path.getsize(path) > self.max_file_bytes
+                ):
+                    rotate_once(path, self.max_files, self.max_file_bytes)
+            except OSError as exc:
+                log.debug("logmon rotate %s failed: %s", path, exc)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check()

@@ -44,6 +44,8 @@ class TaskRunner:
         restart_policy: RestartPolicy,
         on_state_change: Callable[[str, TaskState], None],
         artifact_root: str = "",
+        dispatch_payload: str = "",
+        volume_mounts: Optional[List[tuple]] = None,
     ):
         self.alloc_id = alloc_id
         self.task = task
@@ -55,6 +57,13 @@ class TaskRunner:
         # be fetched from; empty = local sources restricted to the task dir
         # (the reference sandboxes go-getter file fetches the same way).
         self.artifact_root = artifact_root
+        # Base64 payload of a dispatched parameterized job (Job.payload),
+        # written to local/ by the dispatch-payload hook when the task
+        # declares a dispatch_payload block.
+        self.dispatch_payload = dispatch_payload
+        # (host_path, destination, read_only) triples resolved by the
+        # alloc runner's volume hook; linked into the task dir at setup.
+        self.volume_mounts = volume_mounts or []
 
         self.state = TaskState()
         self.handle: Optional[TaskHandle] = None
@@ -106,6 +115,31 @@ class TaskRunner:
             self._done.set()
             return
 
+        # Logmon hook: cap the task's output files (client/logmon/).
+        from .logmon import (
+            DEFAULT_MAX_FILE_BYTES,
+            DEFAULT_MAX_FILES,
+            LogRotator,
+        )
+
+        logs_cfg = self.task.logs or {}
+        self._logmon = LogRotator(
+            [
+                os.path.join(self.task_dir, f"{self.task.name}.stdout"),
+                os.path.join(self.task_dir, f"{self.task.name}.stderr"),
+            ],
+            max_file_bytes=int(logs_cfg.get("max_file_bytes", 0))
+            or int(logs_cfg.get("max_file_size_mb", 0)) * 1024 * 1024
+            or DEFAULT_MAX_FILE_BYTES,
+            max_files=int(logs_cfg.get("max_files", 0)) or DEFAULT_MAX_FILES,
+        )
+        self._logmon.start()
+        try:
+            self._run_loop()
+        finally:
+            self._logmon.stop()
+
+    def _run_loop(self) -> None:
         attached, self._attached = self._attached, None
         while not self._kill.is_set():
             try:
@@ -155,6 +189,29 @@ class TaskRunner:
         os.makedirs(self.task_dir, exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "secrets"), exist_ok=True)
         os.makedirs(os.path.join(self.task_dir, "local"), exist_ok=True)
+        for host_path, dest, read_only in self.volume_mounts:
+            # Volume mount hook: a symlink stands in for a bind mount (the
+            # exec sidecar has no mount namespace of its own; the reference
+            # bind-mounts via the driver, volume_hook.go).
+            target = os.path.join(self.task_dir, dest.lstrip("/"))
+            if not self._inside_task_dir(target):
+                raise ValueError(f"volume destination {dest!r} escapes task dir")
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            if not os.path.islink(target) and not os.path.exists(target):
+                os.symlink(host_path, target)
+        if self.task.dispatch_payload and self.dispatch_payload:
+            # Dispatch-payload hook (task_runner_hooks.go dispatch →
+            # client/allocrunner/taskrunner/dispatch_hook.go): decode the
+            # child job's payload into local/<file>.
+            import base64
+
+            fname = self.task.dispatch_payload.get("file", "input")
+            dest = os.path.join(self.task_dir, "local", fname)
+            if not self._inside_task_dir(dest):
+                raise ValueError("dispatch payload destination escapes task dir")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as fh:
+                fh.write(base64.b64decode(self.dispatch_payload))
         for art in self.task.artifacts or []:
             self._fetch_artifact(art)
         for tpl in self.task.templates or []:

@@ -22,12 +22,15 @@ from ..structs.types import (
     ReschedulePolicy,
     Resources,
     RestartPolicy,
+    ScalingPolicy,
     Service,
     Spread,
     SpreadTarget,
     Task,
     TaskGroup,
     UpdateStrategy,
+    VolumeMount,
+    VolumeRequest,
 )
 from .hcl import parse_hcl
 
@@ -192,6 +195,22 @@ def _group(name: str, body: Dict[str, Any], job: Job) -> TaskGroup:
         tg.stop_after_client_disconnect = duration(
             body["stop_after_client_disconnect"]
         )
+    if "scaling" in body:
+        s = _one(body["scaling"])
+        tg.scaling = ScalingPolicy(
+            min=int(s.get("min", 0)),
+            max=int(s.get("max", 0)),
+            enabled=bool(s.get("enabled", True)),
+            policy=_one(s.get("policy")),
+        )
+    for vname, vbody in _labeled(body.get("volume")):
+        tg.volumes[vname] = VolumeRequest(
+            name=vname,
+            type=vbody.get("type", "host"),
+            source=vbody.get("source", vname),
+            read_only=bool(vbody.get("read_only", False)),
+            per_alloc=bool(vbody.get("per_alloc", False)),
+        )
     for tname, tbody in _labeled(body.get("task")):
         tg.tasks.append(_task(tname, tbody))
     if not tg.tasks:
@@ -246,6 +265,23 @@ def _task(name: str, body: Dict[str, Any]) -> Task:
         t.artifacts.append(sbody)
     for sbody in _many(body.get("template")):
         t.templates.append(sbody)
+    if "dispatch_payload" in body:
+        dp = _one(body["dispatch_payload"])
+        t.dispatch_payload = {"file": dp.get("file", "input")}
+    if "logs" in body:
+        lg = _one(body["logs"])
+        t.logs = {
+            "max_files": int(lg.get("max_files", 10)),
+            "max_file_size_mb": int(lg.get("max_file_size", lg.get(
+                "max_file_size_mb", 10
+            ))),
+        }
+    for vm in _many(body.get("volume_mount")):
+        t.volume_mounts.append(VolumeMount(
+            volume=vm.get("volume", ""),
+            destination=vm.get("destination", ""),
+            read_only=bool(vm.get("read_only", False)),
+        ))
     return t
 
 
@@ -373,6 +409,9 @@ def api_to_job(data: Dict[str, Any]) -> Job:
                     ],
                     "affinities": lambda as_: [build(Affinity, a) for a in as_],
                     "services": lambda ss: [build(Service, s) for s in ss],
+                    "volume_mounts": lambda vms: [
+                        build(VolumeMount, v) for v in vms
+                    ],
                 },
             )
             for t in (items or [])
@@ -407,6 +446,11 @@ def api_to_job(data: Dict[str, Any]) -> Job:
                     "networks": lambda ns: [
                         build(NetworkResource, n) for n in ns
                     ],
+                    "scaling": lambda s: build(ScalingPolicy, s)
+                    if s else None,
+                    "volumes": lambda vs: {
+                        k: build(VolumeRequest, v) for k, v in vs.items()
+                    },
                 },
             )
             for g in (items or [])

@@ -143,6 +143,10 @@ class CompiledTaskGroup:
     affinities: List[Affinity] = field(default_factory=list)
     drivers: List[str] = field(default_factory=list)
     host_volumes: List[str] = field(default_factory=list)
+    # Registered-volume asks (type "csi"): checked host-side against the
+    # volume table's claims (stack._host_mask; HostVolumeChecker /
+    # CSIVolumeChecker, feasible.go:132,209).
+    csi_volumes: List["VolumeRequest"] = field(default_factory=list)
 
 
 def _resolve_attr_name(target: str) -> Optional[str]:
@@ -389,7 +393,13 @@ class RequestEncoder:
             spreads=spreads,
             affinities=affinities,
             drivers=drivers,
-            host_volumes=[],
+            host_volumes=[
+                v.source or v.name
+                for v in (tg.volumes or {}).values() if v.type == "host"
+            ],
+            csi_volumes=[
+                v for v in (tg.volumes or {}).values() if v.type == "csi"
+            ],
         )
 
     # -- predicate encoding --------------------------------------------------

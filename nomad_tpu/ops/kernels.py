@@ -523,13 +523,19 @@ class PlacementResult(NamedTuple):
     tg_count_after: jnp.ndarray  # (N,)
 
 
-def _update_spread_counts(spread_counts, req: SchedRequest, arrays, row):
-    """After placing on ``row``, bump the count of that node's attribute value
-    per stanza (propertyset.go usage tracking). Claims an empty value slot on
-    first sight of a new value."""
+def spread_values_at(arrays, req: SchedRequest, row):
+    """Per-stanza attribute hash of node ``row`` ((S,) i32) — split out so
+    the node-sharded step can compute it on the winning row's owner shard
+    and broadcast (parallel/sharding.py)."""
+    return arrays.attr_hash[row, jnp.maximum(req.s_slot, 0)]
 
-    def one(slot, value_hash, counts):
-        nvalue = arrays.attr_hash[row, jnp.maximum(slot, 0)]
+
+def apply_spread_values(spread_counts, req: SchedRequest, nvalues):
+    """Bump per-stanza counts for the placed node's attribute values
+    (propertyset.go usage tracking). Claims an empty value slot on first
+    sight of a new value.  ``nvalues``: (S,) i32 from spread_values_at."""
+
+    def one(slot, value_hash, counts, nvalue):
         match = (value_hash == nvalue) & (nvalue != 0)
         have = jnp.any(match)
         free_slot = jnp.argmax(value_hash == 0)
@@ -541,10 +547,17 @@ def _update_spread_counts(spread_counts, req: SchedRequest, arrays, row):
         new_counts = jnp.where(can, counts.at[idx].add(1.0), counts)
         return new_hash, new_counts
 
-    new_hashes, new_counts = jax.vmap(one)(
-        req.s_slot, req.s_value_hash, spread_counts
+    return jax.vmap(one)(
+        req.s_slot, req.s_value_hash, spread_counts, nvalues
     )
-    return new_hashes, new_counts
+
+
+def _update_spread_counts(spread_counts, req: SchedRequest, arrays, row):
+    """After placing on ``row``, bump the count of that node's attribute
+    value per stanza."""
+    return apply_spread_values(
+        spread_counts, req, spread_values_at(arrays, req, row)
+    )
 
 
 def _place_scan(

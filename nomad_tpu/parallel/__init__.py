@@ -11,6 +11,7 @@ from .sharding import (
     build_batch_inputs,
     make_mesh,
     shard_matrix_arrays,
+    sharded_place_batch,
     sharded_schedule_step,
     stack_requests,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "build_batch_inputs",
     "make_mesh",
     "shard_matrix_arrays",
+    "sharded_place_batch",
     "sharded_schedule_step",
     "stack_requests",
 ]

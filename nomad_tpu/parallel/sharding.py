@@ -36,7 +36,12 @@ except ImportError:  # older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.encode import SchedRequest, pow2_bucket
-from ..ops.kernels import NEG_INF, score_nodes
+from ..ops.kernels import (
+    NEG_INF,
+    apply_spread_values,
+    score_nodes,
+    spread_values_at,
+)
 from ..state.matrix import DeviceArrays
 
 
@@ -262,5 +267,151 @@ def sharded_schedule_step(mesh: Mesh):
             P("batch"),
             P("node", None),
         ),
+    )
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Sharded dispatch-coalescer kernel (the LIVE multi-chip path)
+# ---------------------------------------------------------------------------
+
+
+def _place_batch_local(
+    arrays, used, delta_rows, delta_vals, tg_counts, spread_counts,
+    penalties, reqs, class_eligs, host_masks, n_placements,
+):
+    """Per-shard body of the coalescer's ``place_batch`` (ops/kernels.py:659)
+    under a ('batch', 'node') mesh: each shard scores its own node rows, the
+    per-placement argmax crosses shards over ICI (pmax score + pmin row, so
+    ties break to the lowest global row exactly like the single-device
+    ``jnp.argmax``), and the winning shard alone applies the usage/tg-count
+    update.  Spread-count updates need the winning node's attribute values,
+    which live on one shard — the owner broadcasts them with a psum.
+    """
+    n_local = used.shape[0]
+    shard = jax.lax.axis_index("node")
+    row_offset = shard * n_local
+    big = jnp.int32(2 ** 30)
+
+    def one(drows, dvals, tg, sc, pen, req, ce, hm):
+        # Sparse in-flight plan deltas arrive as GLOBAL rows; each shard
+        # applies the slice it owns.
+        local = drows - row_offset
+        mine = (drows >= 0) & (local >= 0) & (local < n_local)
+        safe = jnp.clip(local, 0, n_local - 1)
+        used0 = used.at[safe].add(jnp.where(mine[:, None], dvals, 0.0))
+
+        def step(carry, _):
+            u, tg_cnt, s_hash, s_counts = carry
+            req_step = req._replace(s_value_hash=s_hash)
+            res = score_nodes(
+                arrays, u, tg_cnt, s_counts, pen, req_step, ce, hm
+            )
+            lrow = jnp.argmax(res.final).astype(jnp.int32)
+            lok = res.final[lrow] > NEG_INF / 2
+            score = jnp.where(lok, res.final[lrow], NEG_INF)
+            best = jax.lax.pmax(score, "node")
+            cand = jnp.where(
+                lok & (score == best), row_offset + lrow, big
+            )
+            grow = jax.lax.pmin(cand, "node")  # lowest row wins ties
+            ok = best > NEG_INF / 2
+            grow = jnp.where(ok, grow, -1)
+            owner = ok & (grow >= row_offset) & (grow < row_offset + n_local)
+            lwin = jnp.clip(grow - row_offset, 0, n_local - 1)
+
+            n_eval = jax.lax.psum(
+                jnp.sum(res.feasible.astype(jnp.int32)), "node"
+            )
+            n_filt = jax.lax.psum(
+                jnp.sum((~res.feasible & arrays.eligible).astype(jnp.int32)),
+                "node",
+            )
+            n_exh = jax.lax.psum(
+                jnp.sum((res.feasible & ~res.fits).astype(jnp.int32)), "node"
+            )
+
+            u2 = jnp.where(owner, u.at[lwin].add(req.ask), u)
+            tg2 = jnp.where(owner, tg_cnt.at[lwin].add(1), tg_cnt)
+
+            # Winning node's per-stanza attr values: owner computes, psum
+            # broadcasts (hash 0 = "no value", so non-owners contribute 0).
+            nvals = jnp.where(
+                owner, spread_values_at(arrays, req_step, lwin), 0
+            )
+            nvals = jax.lax.psum(nvals, "node")
+            new_hash, new_counts = apply_spread_values(
+                s_counts, req_step, nvals
+            )
+            s_hash2 = jnp.where(ok, new_hash, s_hash)
+            s_counts2 = jnp.where(ok, new_counts, s_counts)
+
+            binp = jax.lax.psum(
+                jnp.where(owner, res.binpack[lwin], 0.0), "node"
+            )
+            pre = jax.lax.pmax(
+                jnp.where(
+                    owner, res.needs_preempt[lwin], False
+                ).astype(jnp.int32),
+                "node",
+            ).astype(bool)
+            out = (
+                grow,
+                jnp.where(ok, best, 0.0),
+                jnp.where(ok, binp, 0.0),
+                pre & ok,
+                n_eval,
+                n_filt,
+                n_exh,
+            )
+            return (u2, tg2, s_hash2, s_counts2), out
+
+        init = (used0, tg, req.s_value_hash, sc)
+        _, outs = jax.lax.scan(step, init, None, length=n_placements)
+        rows, scores, binpack, pre, ne, nf, nx = outs
+        return jnp.stack(
+            [
+                rows.astype(jnp.float32),
+                scores,
+                binpack,
+                pre.astype(jnp.float32),
+                ne.astype(jnp.float32),
+                nf.astype(jnp.float32),
+                nx.astype(jnp.float32),
+            ],
+            axis=1,
+        )  # (P, 7) — kernels.PACKED_* layout
+
+    return jax.vmap(one)(
+        delta_rows, delta_vals, tg_counts, spread_counts, penalties, reqs,
+        class_eligs, host_masks,
+    )
+
+
+def sharded_place_batch(mesh: Mesh, n_placements: int):
+    """Build the jitted SPMD twin of ``kernels.place_batch`` for ``mesh``.
+
+    Same signature and packed (B, P, PACKED_WIDTH) result as the unsharded
+    kernel, so the dispatch coalescer swaps it in transparently when the
+    server runs on a multi-chip slice (scheduler/coalescer.py).  Placement
+    parity with the single-device kernel is exact (tie-breaks included) —
+    tests/test_parallel.py asserts it.
+    """
+    fn = shard_map(
+        functools.partial(_place_batch_local, n_placements=n_placements),
+        mesh=mesh,
+        in_specs=(
+            _ARRAYS_SPEC,
+            P("node", None),  # used
+            P("batch", None),  # delta_rows (global ids, replicated on node)
+            P("batch", None, None),  # delta_vals
+            P("batch", "node"),  # tg_counts
+            P("batch", None, None),  # spread_counts
+            P("batch", "node"),  # penalties
+            _REQS_SPEC,
+            P("batch", None),  # class_eligs
+            P("batch", "node"),  # host_masks
+        ),
+        out_specs=P("batch", None, None),
     )
     return jax.jit(fn)

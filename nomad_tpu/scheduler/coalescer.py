@@ -94,6 +94,7 @@ class DeviceCoalescer:
         scan_length: Optional[int] = None,
         linger_s: float = 0.002,
         max_inflight: int = 4,
+        n_device_shards: Optional[int] = None,
     ):
         from .stack import PLACEMENT_CHUNK
 
@@ -102,6 +103,15 @@ class DeviceCoalescer:
         self.scan_length = scan_length or PLACEMENT_CHUNK
         self.linger_s = linger_s
         self.max_inflight = max_inflight
+        # Multi-chip: when >1, dispatches go through the SPMD twin of
+        # place_batch (parallel/sharding.py sharded_place_batch) over a
+        # ('batch', 'node') mesh — the live server path the dryrun
+        # certifies.  None = auto: all visible devices on real
+        # accelerators, single-device on CPU (the virtual 8-CPU rig is a
+        # test harness, not a deployment; tests opt in explicitly).
+        self.n_device_shards = n_device_shards
+        self._mesh = None
+        self._sharded_fn = None
         self._queue: List[_Pending] = []
         # Arbitrary device closures (system feasibility, bulk plan verify,
         # oversized-delta solo selects) executed on the dispatch thread so
@@ -257,9 +267,33 @@ class DeviceCoalescer:
 
     # ------------------------------------------------------------------
 
+    def _resolve_sharding(self) -> int:
+        """Decide (once) how many devices dispatches span."""
+        if self.n_device_shards is None:
+            import jax
+
+            devs = jax.devices()
+            self.n_device_shards = (
+                len(devs) if devs[0].platform != "cpu" and len(devs) > 1
+                else 1
+            )
+        if self.n_device_shards > 1 and self._sharded_fn is None:
+            from ..parallel.sharding import make_mesh, sharded_place_batch
+
+            self._mesh = make_mesh(self.n_device_shards)
+            self._sharded_fn = sharded_place_batch(
+                self._mesh, self.scan_length
+            )
+            log.info(
+                "coalescer: multi-chip dispatch over mesh %s",
+                dict(zip(self._mesh.axis_names, self._mesh.devices.shape)),
+            )
+        return self.n_device_shards
+
     def _dispatch(self, batch: List[_Pending]):
         import jax
 
+        n_shards = self._resolve_sharding()
         with DEVICE_LOCK:
             arrays = self.matrix.sync()
         n = int(arrays.used.shape[0])
@@ -313,7 +347,7 @@ class DeviceCoalescer:
         reqs = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *[p.request for p in lanes]
         )
-        packed = kernels.place_batch(
+        args = (
             arrays,
             arrays.used,
             np.stack([p.delta_rows for p in lanes]),
@@ -324,9 +358,19 @@ class DeviceCoalescer:
             reqs,
             np.stack([p.class_elig for p in lanes]),
             np.stack([p.host_mask for p in lanes]),
-            n_placements=self.scan_length,
         )
-        return packed
+        if n_shards > 1:
+            from ..parallel.sharding import shard_matrix_arrays
+
+            # Lay the matrix across the mesh's node axis.  (Sharded-
+            # resident incremental updates are a further optimization;
+            # today the authoritative copy lives on device 0 and re-lays
+            # per dispatch.)
+            sharded = shard_matrix_arrays(self._mesh, arrays)
+            return self._sharded_fn(
+                sharded, sharded.used, *args[2:]
+            )
+        return kernels.place_batch(*args, n_placements=self.scan_length)
 
     def _resolve(self, packed, entries: List[_Pending]) -> None:
         try:

@@ -50,10 +50,15 @@ class CoreScheduler:
     def process(self, ev: Evaluation) -> None:
         force = ev.job_id == CORE_JOB_FORCE_GC
         kind = ev.job_id
-        if force or kind == CORE_JOB_EVAL_GC:
-            self._eval_gc(force)
+        # Job GC must precede eval GC in a forced sweep: eval GC deletes a
+        # dead batch job's terminal evals+allocs, after which the job no
+        # longer looks dead (batch-dead = "has allocs, all terminal") and
+        # would survive every force-gc (the reference's forceGC runs jobGC
+        # first for the same reason, core_sched.go).
         if force or kind == CORE_JOB_JOB_GC:
             self._job_gc(force)
+        if force or kind == CORE_JOB_EVAL_GC:
+            self._eval_gc(force)
         if force or kind == CORE_JOB_DEPLOYMENT_GC:
             self._deployment_gc(force)
         if force or kind == CORE_JOB_NODE_GC:

@@ -253,6 +253,28 @@ class GenericStack:
                 elig[cid] = ok
         return elig
 
+    def _volume_claimable(self, vol, vreq, job: Job) -> bool:
+        """Do the volume's live claims admit this request?  Claims from
+        terminal (or vanished) allocs don't count — the volume watcher
+        releases them lazily; claims from THIS job's own allocs don't
+        block a replacement placement (the reconciler stops them in the
+        same plan)."""
+        if vreq.read_only or vol.access_mode == "multi-node-multi-writer":
+            return True
+        if vol.access_mode != "single-node-writer":
+            return False  # reader-only volume cannot take a writer
+        snap = self.ctx.snapshot
+        for alloc_id in vol.write_claims:
+            a = snap.alloc_by_id(alloc_id) if hasattr(
+                snap, "alloc_by_id"
+            ) else None
+            if a is None or a.terminal_status():
+                continue
+            if a.namespace == job.namespace and a.job_id == job.id:
+                continue
+            return False
+        return True
+
     def _host_mask(
         self, job: Job, tg: TaskGroup, compiled: CompiledTaskGroup
     ) -> Optional[np.ndarray]:
@@ -278,7 +300,20 @@ class GenericStack:
             if e.constraint.operand == Op.DISTINCT_PROPERTY.value
         ]
 
-        if unique or compiled.host_volumes or compiled.escaped_devices or compiled.dc_escaped:
+        # Registered-volume feasibility (CSIVolumeChecker, feasible.go:209):
+        # the volume must exist, its claims must admit this request, and
+        # only nodes exposing its backing host volume qualify.
+        csi_sources: List[str] = []
+        for vreq in compiled.csi_volumes:
+            vol = self.ctx.snapshot.volume_by_id(job.namespace, vreq.source)
+            if vol is None or not self._volume_claimable(vol, vreq, job):
+                return np.zeros((n,), bool)  # nothing feasible → blocked
+            csi_sources.append(vol.source)
+
+        if (
+            unique or compiled.host_volumes or csi_sources
+            or compiled.escaped_devices or compiled.dc_escaped
+        ):
             m = ensure()
             dcs = set(job.datacenters)
             for node_id, row in self.matrix.row_of.items():
@@ -296,6 +331,11 @@ class GenericStack:
                     continue
                 if compiled.host_volumes and not check_host_volumes(
                     node, compiled.host_volumes
+                ):
+                    m[row] = False
+                    continue
+                if csi_sources and not check_host_volumes(
+                    node, csi_sources
                 ):
                     m[row] = False
                     continue

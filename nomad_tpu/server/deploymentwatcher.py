@@ -90,6 +90,10 @@ class DeploymentWatcher:
     def _check_deployment(self, dep) -> None:
         store = self.server.store
         now = time.time()
+        if dep.status == DeploymentStatus.PAUSED.value:
+            # Operator paused (Deployment.Pause): no pacing evals, no
+            # deadline enforcement until resumed.
+            return
         allocs = [
             a for a in store.allocs.values() if a.deployment_id == dep.id
         ]

@@ -150,7 +150,9 @@ class NodeDrainer:
                         if a.job is not None
                         else None
                     )
-                    migrate = tg.migrate if tg is not None else None
+                    migrate = (
+                        tg.migrate_strategy if tg is not None else None
+                    )
                     max_parallel = migrate.max_parallel if migrate else 1
                     if inflight.get(key, 0) >= max_parallel:
                         continue

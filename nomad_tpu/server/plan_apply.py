@@ -92,8 +92,20 @@ class PlanApplier:
                 )
         store = self.server.store
         with self.server.metrics.timer("nomad.plan.apply").time():
-            with store._lock:
-                result, index = self._apply_locked(plan)
+            # Lock ORDER must match the journaled-writer wrapper
+            # (_write_lock → _lock, state/store.py journaled): the commit
+            # inside _apply_locked re-enters it, and taking _lock alone
+            # first inverts against every concurrent writer — a deadlock
+            # observed as a full server freeze under an eval burst.
+            # Known cost on REPLICATED clusters: because this frame holds
+            # _lock re-entrantly, the nested journaled write's quorum
+            # round-trip runs with the read lock held for plan commits
+            # (only).  Fixing it means staging the verify outside the
+            # locks and re-verifying inside — the pipeline split is
+            # tracked, not yet done.
+            with store._write_lock:
+                with store._lock:
+                    result, index = self._apply_locked(plan)
         if index:
             self.server.on_plan_applied(plan, result, index)
         return result
@@ -168,6 +180,10 @@ class PlanApplier:
         if not node_ids:
             return failed
 
+        # Exclusive-volume writers admitted earlier in THIS plan's walk:
+        # (namespace, volume_id) -> count.
+        plan_claims: Dict[tuple, int] = {}
+
         rows: List[int] = []
         deltas: List[np.ndarray] = []
         checked: List[str] = []
@@ -237,6 +253,14 @@ class PlanApplier:
                 failed.add(nid)
                 continue
 
+            # Volume-claim re-verify: two optimistic plans (or two nodes in
+            # one plan) must not both claim an exclusive registered volume
+            # (csi_endpoint.go claim serialization — here the serialized
+            # applier IS the claim gate).
+            if not self._volumes_fit(plan, nid, plan_claims):
+                failed.add(nid)
+                continue
+
             rows.append(row)
             deltas.append(delta)
             checked.append(nid)
@@ -265,6 +289,52 @@ class PlanApplier:
             if not bool(ok):
                 failed.add(nid)
         return failed
+
+    def _volumes_fit(
+        self, plan: Plan, nid: str, plan_claims: Dict[tuple, int]
+    ) -> bool:
+        """Re-check registered-volume claims for this node's NEW placements
+        against authoritative state + claims granted earlier in this plan."""
+        store = self.server.store
+        stopping = {
+            s.id for lst in plan.node_update.values() for s in lst
+        }
+        for a in plan.node_allocation[nid]:
+            if a.id in store.allocs:
+                continue  # in-place update: claim already held
+            job = a.job
+            tg = job.lookup_task_group(a.task_group) if job else None
+            if tg is None or not tg.volumes:
+                continue
+            for vreq in tg.volumes.values():
+                if vreq.type != "csi":
+                    continue
+                vol = store.volume_by_id(a.namespace, vreq.source)
+                if vol is None:
+                    return False
+                writer = not vreq.read_only
+                if not writer or vol.access_mode == "multi-node-multi-writer":
+                    continue
+                if vol.access_mode != "single-node-writer":
+                    return False  # reader-only volume cannot take a writer
+                key = (a.namespace, vol.id)
+                # Same-job live claims don't block (mirrors the stack's
+                # _volume_claimable): a canary/replacement placement must
+                # not deadlock against the alloc it will replace.
+                live_foreign = any(
+                    (prev := store.allocs.get(aid)) is not None
+                    and not prev.terminal_status()
+                    and aid not in stopping
+                    and not (
+                        prev.namespace == a.namespace
+                        and prev.job_id == a.job_id
+                    )
+                    for aid in vol.write_claims
+                )
+                if live_foreign or plan_claims.get(key, 0) > 0:
+                    return False
+                plan_claims[key] = plan_claims.get(key, 0) + 1
+        return True
 
     def _ports_fit(self, plan: Plan, node, nid: str) -> bool:
         """Exact host-side port check against authoritative state: claimed =

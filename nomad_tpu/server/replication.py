@@ -151,6 +151,9 @@ class Replicator:
         # compacted past the follower's position).
         self._log_ring: "OrderedDict[int, Dict]" = OrderedDict()
         self._last_heartbeat = time.monotonic()
+        # Observability: how followers were caught up (tests + stats).
+        self.repair_resends = 0  # leader: suffix re-sends that succeeded
+        self.snapshots_installed = 0  # follower: full-image installs
 
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -180,6 +183,19 @@ class Replicator:
 
     def quorum(self) -> int:
         return (len(self.peers) + 1) // 2 + 1
+
+    def update_peers(self, addrs) -> None:
+        """Apply a membership change (server join/leave): reconcile the
+        live peer map against the full member address list, preserving
+        the health state of peers that remain."""
+        with self._lock:
+            want = {a for a in addrs if a and a != self.self_addr}
+            for a in list(self.peers):
+                if a not in want:
+                    del self.peers[a]
+            for a in want:
+                if a not in self.peers:
+                    self.peers[a] = PeerState(addr=a)
 
     def ensure_leader(self) -> None:
         if not self.is_leader:
@@ -362,6 +378,8 @@ class Replicator:
                     if out2.get("OK"):
                         peer.healthy = True
                         peer.retry_after = 0.0
+                        with self._lock:
+                            self.repair_resends += 1
                         log.info("caught %s up by re-send (%d entries)",
                                  peer.addr, len(suffix))
                         return True
@@ -461,6 +479,10 @@ class Replicator:
             )
             with self._lock:
                 self.last_seq = int(body.get("Seq", 0))
+                self.snapshots_installed += 1
+                # The ring predates the install; anything in it no longer
+                # matches the new log position.
+                self._log_ring.clear()
                 return {"OK": True, "Term": self.term}
 
     def handle_vote(self, body: Dict) -> Dict:

@@ -70,12 +70,18 @@ class ServerConfig:
     core_gc_interval: float = 300.0
     # Max selects batched into one device dispatch (scheduler/coalescer.py).
     coalescer_lanes: int = 64
+    # Devices the coalescer shards dispatches over (parallel/sharding.py).
+    # None = auto: every visible chip on real accelerators, 1 on CPU.
+    n_device_shards: Optional[int] = None
     # ACL enforcement (acl/; nomad/server.go:88-91 token resolution).
     acl_enabled: bool = False
     # Multi-server consensus (server/replication.py): peer HTTP addresses.
     # Empty = single-server (immediate leadership, no replication).
     server_id: str = ""
     peers: List[str] = field(default_factory=list)
+    # Run replication even with no configured peers (a single-server
+    # cluster that expects `server join` to grow it later).
+    raft_enabled: bool = False
     election_timeout: tuple = (0.25, 0.5)
     raft_heartbeat_interval: float = 0.08
     # Shared secret authenticating server↔server raft RPCs; required on
@@ -134,7 +140,8 @@ class Server:
         from ..scheduler.coalescer import DeviceCoalescer
 
         self.coalescer = DeviceCoalescer(
-            self.matrix, max_lanes=self.config.coalescer_lanes
+            self.matrix, max_lanes=self.config.coalescer_lanes,
+            n_device_shards=self.config.n_device_shards,
         )
         self.matrix.coalescer = self.coalescer
 
@@ -168,6 +175,48 @@ class Server:
             state_dir=self.config.data_dir,
         )
         self.store.replicator = self.replicator
+        # Membership replicated through state (server join/leave) wins
+        # over the static config list — a WAL-restored server rejoins the
+        # set it last knew, not the one it booted with.
+        if self.store.raft_peers:
+            self.replicator.update_peers(self.store.raft_peers)
+
+    # ------------------------------------------------------------------
+    # Membership (nomad/serf.go join + operator_endpoint.go
+    # RaftRemovePeer — here an explicit replicated configuration change)
+    # ------------------------------------------------------------------
+
+    def _current_members(self) -> List[str]:
+        rep = self.replicator
+        if self.store.raft_peers:
+            return list(self.store.raft_peers)
+        members = set(self.config.peers)
+        if rep is not None:
+            members.add(rep.self_addr)
+            members.update(rep.peers)
+        return sorted(members)
+
+    def join_peer(self, addr: str) -> List[str]:
+        """Leader-side `server join`: add a member and replicate the new
+        configuration; the heartbeat loop then snapshots/repairs the
+        newcomer up to date."""
+        if self.replicator is None:
+            raise ValueError("server is not running replication")
+        self.replicator.ensure_leader()
+        members = set(self._current_members())
+        members.add(addr)
+        self.store.set_raft_peers(self.next_index(), sorted(members))
+        return sorted(members)
+
+    def remove_peer(self, addr: str) -> List[str]:
+        """Dead-peer eviction by operator command (RaftRemovePeer)."""
+        if self.replicator is None:
+            raise ValueError("server is not running replication")
+        self.replicator.ensure_leader()
+        members = set(self._current_members())
+        members.discard(addr)
+        self.store.set_raft_peers(self.next_index(), sorted(members))
+        return sorted(members)
 
     # ------------------------------------------------------------------
     # Log index — the Raft seam. Every mutation gets a unique, monotonic
@@ -272,6 +321,25 @@ class Server:
     # ------------------------------------------------------------------
 
     def submit_job(self, job: Job) -> Optional[Evaluation]:
+        # Admission validation (job_endpoint_hooks.go validate): an
+        # exclusive-writer volume cannot back more than one alloc.
+        for tg in job.task_groups:
+            for vreq in (tg.volumes or {}).values():
+                if (
+                    vreq.type == "csi" and not vreq.read_only
+                    and not vreq.per_alloc and tg.count > 1
+                ):
+                    vol = self.store.volume_by_id(
+                        job.namespace, vreq.source
+                    )
+                    if vol is not None and vol.access_mode == (
+                        "single-node-writer"
+                    ):
+                        raise ValueError(
+                            f"group {tg.name!r}: volume {vreq.source!r} "
+                            "has single-node-writer access mode but "
+                            f"count={tg.count}"
+                        )
         index = self.next_index()
         job.submit_time = time.time()
         job.status = JobStatus.PENDING.value
@@ -306,7 +374,9 @@ class Server:
         (ACL.Bootstrap, nomad/acl_endpoint.go)."""
         from ..structs.types import ACLToken
 
-        with self.store._lock:
+        # Same lock order as the journaled wrapper (_write_lock → _lock);
+        # _lock alone around a journaled write inverts and can deadlock.
+        with self.store._write_lock, self.store._lock:
             if self.store.has_management_token():
                 raise PermissionError("ACL already bootstrapped")
             token = ACLToken(
@@ -755,6 +825,154 @@ class Server:
         reverted.stop = False
         return self.submit_job(reverted)
 
+    def pause_deployment(self, deployment_id: str, pause: bool) -> None:
+        """Pause/resume a rolling update (Deployment.Pause,
+        nomad/deployment_endpoint.go): paused deployments are skipped by
+        the watcher's pacing loop until resumed."""
+        from ..structs.types import DeploymentStatus
+
+        self.update_deployment_status(
+            deployment_id,
+            DeploymentStatus.PAUSED.value if pause
+            else DeploymentStatus.RUNNING.value,
+            "Deployment is paused" if pause
+            else "Deployment is running",
+        )
+
+    # ------------------------------------------------------------------
+    # Parameterized dispatch + scaling (nomad/job_endpoint.go:1849
+    # Dispatch, :980 Scale)
+    # ------------------------------------------------------------------
+
+    # structs.DispatchPayloadSizeLimit (16 KiB), pre-base64.
+    DISPATCH_PAYLOAD_LIMIT = 16 * 1024
+
+    def dispatch_job(
+        self,
+        namespace: str,
+        job_id: str,
+        payload: bytes = b"",
+        meta: Optional[Dict[str, str]] = None,
+    ) -> Tuple[Optional["Job"], Optional[Evaluation]]:
+        """Instantiate a parameterized job as a dispatched child
+        (Job.Dispatch): validate meta against meta_required/meta_optional,
+        stamp the payload, and register ``<id>/dispatch-<ts>-<uuid>``."""
+        import base64
+
+        from ..structs.types import generate_uuid
+
+        parent = self.store.job_by_id(namespace, job_id)
+        if parent is None:
+            raise ValueError("job not found")
+        if not parent.is_parameterized():
+            raise ValueError("job is not parameterized")
+        if parent.stop:
+            raise ValueError("job is stopped")
+        spec = parent.parameterized or {}
+        meta = dict(meta or {})
+        required = set(spec.get("meta_required", []))
+        optional = set(spec.get("meta_optional", []))
+        missing = required - set(meta)
+        if missing:
+            raise ValueError(f"missing required meta: {sorted(missing)}")
+        unexpected = set(meta) - required - optional
+        if unexpected:
+            raise ValueError(f"unpermitted meta: {sorted(unexpected)}")
+        payload_mode = spec.get("payload", "optional")
+        if payload and payload_mode == "forbidden":
+            raise ValueError("payload forbidden by parameterized block")
+        if not payload and payload_mode == "required":
+            raise ValueError("payload required by parameterized block")
+        if len(payload) > self.DISPATCH_PAYLOAD_LIMIT:
+            raise ValueError("payload exceeds 16 KiB limit")
+
+        child = parent.copy()
+        child.id = (
+            f"{parent.id}/dispatch-{int(time.time())}-"
+            f"{generate_uuid()[:8]}"
+        )
+        child.name = child.id
+        child.parent_id = parent.id
+        child.parameterized = None
+        child.periodic = None
+        child.meta = {**parent.meta, **meta}
+        child.payload = base64.b64encode(payload).decode() if payload else ""
+        child.version = 0
+        ev = self.submit_job(child)
+        return child, ev
+
+    def scale_job(
+        self,
+        namespace: str,
+        job_id: str,
+        group: str,
+        count: Optional[int],
+        message: str = "",
+        error: bool = False,
+        meta: Optional[Dict] = None,
+    ) -> Optional[Evaluation]:
+        """Set a group's count (Job.Scale): bounds-checked against the
+        group's scaling policy, records a ScalingEvent, and registers the
+        updated job (a new version, like the reference's raft apply)."""
+        from ..structs.types import ScalingEvent
+
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError("job not found")
+        if not group and len(job.task_groups) == 1:
+            group = job.task_groups[0].name
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(f"no task group {group!r}")
+        if error and count is not None:
+            raise ValueError("scale cannot carry both count and error")
+
+        ev: Optional[Evaluation] = None
+        prev_count = tg.count
+        if count is not None:
+            if count < 0:
+                raise ValueError("count cannot be negative")
+            pol = tg.scaling
+            if pol is not None and pol.enabled:
+                if count < pol.min or (pol.max and count > pol.max):
+                    raise ValueError(
+                        f"count {count} outside policy bounds "
+                        f"[{pol.min}, {pol.max}]"
+                    )
+            updated = job.copy()
+            updated.lookup_task_group(group).count = count
+            ev = self.submit_job(updated)
+        self.store.record_scaling_event(
+            self.next_index(), namespace, job_id, group,
+            ScalingEvent(
+                time=time.time(),
+                count=count,
+                previous_count=prev_count,
+                message=message,
+                error=error,
+                eval_id=ev.id if ev else "",
+                meta=dict(meta or {}),
+            ),
+        )
+        return ev
+
+    def system_gc(self) -> None:
+        """Force a full GC sweep now (System.GarbageCollect,
+        nomad/system_endpoint.go): one force-gc core eval through the
+        normal broker/worker path."""
+        from ..scheduler.core import CORE_JOB_FORCE_GC
+
+        self.apply_eval_updates([
+            Evaluation(
+                namespace="-",
+                priority=100,
+                type="_core",
+                triggered_by=EvalTrigger.SCHEDULED.value,
+                job_id=CORE_JOB_FORCE_GC,
+                status=EvalStatus.PENDING.value,
+            )
+        ])
+
     # ------------------------------------------------------------------
     # Drainer + periodic applies
     # ------------------------------------------------------------------
@@ -860,6 +1078,24 @@ class Server:
                 cancelled = dup.copy()
                 cancelled.status = EvalStatus.CANCELLED.value
                 self.store.upsert_evals(self.next_index(), [cancelled])
+            # Volume watcher (nomad/volumewatcher/volumes_watcher.go):
+            # release claims held by terminal or vanished allocs, then
+            # unblock evals that failed placement awaiting the volume.
+            released = False
+            for (ns, vid), vol in list(self.store.volumes.items()):
+                stale = [
+                    aid
+                    for aid in list(vol.read_claims) + list(vol.write_claims)
+                    if (a := self.store.alloc_by_id(aid)) is None
+                    or a.terminal_status()
+                ]
+                if stale:
+                    self.store.release_volume_claims(
+                        self.next_index(), ns, vid, stale
+                    )
+                    released = True
+            if released:
+                self.blocked_evals.unblock_all(self.store.latest_index)
             # Periodic core GC evals (leader.go:686 schedulePeriodic →
             # core_sched.go job names), processed by the CoreScheduler.
             now = time.time()
@@ -893,6 +1129,29 @@ class Server:
     # ------------------------------------------------------------------
     # Introspection helpers
     # ------------------------------------------------------------------
+
+    def get_alloc_fs_origin(self, alloc_id: str) -> Dict:
+        """Where a (previous) allocation's files live + whether it stopped
+        writing — the cross-node ephemeral-disk migration handshake
+        (client/allocwatcher remote prevAllocMigrator; the reference
+        streams via the FS API the same way)."""
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            return {"Addr": "", "Terminal": True}
+        node = self.store.node_by_id(alloc.node_id)
+        addr = ""
+        if node is not None:
+            addr = node_attributes(node).get("nomad.advertise.address", "")
+        return {"Addr": addr, "Terminal": alloc.terminal_status()}
+
+    def get_volume_source(
+        self, namespace: str, volume_id: str
+    ) -> Optional[str]:
+        """Client-side volume hook resolution: registered volume id → the
+        backing host-volume name nodes expose (the CSI node-stage analog;
+        the reference ships mount info inside the CSI plugin RPCs)."""
+        vol = self.store.volume_by_id(namespace, volume_id)
+        return vol.source if vol is not None else None
 
     def get_client_allocs(
         self, node_id: str, min_index: int = 0, timeout: float = 30.0

@@ -203,6 +203,20 @@ class StateStore:
         self.deployments: Dict[str, Deployment] = {}
         self.job_summaries: Dict[Tuple[str, str], JobSummary] = {}
         self.periodic_launch: Dict[Tuple[str, str], float] = {}
+        # Scaling (nomad/state/schema.go scaling_policy + scaling_event
+        # tables).  Policies are a VIEW derived from job specs (updated on
+        # job upsert/delete — deterministic from job writes, so replay- and
+        # replication-safe without their own journal entries); events are
+        # journaled history rings keyed by (ns, job, group).
+        self.scaling_policies: Dict[Tuple[str, str, str], "ScalingPolicy"] = {}
+        self.scaling_events: Dict[Tuple[str, str, str], List["ScalingEvent"]] = {}
+        # Registered volumes (csi_volumes table analog) by (ns, id).
+        self.volumes: Dict[Tuple[str, str], "Volume"] = {}
+        # Server membership (the raft configuration-change analog,
+        # nomad/serf.go + RaftRemovePeer): the full member address list,
+        # replicated like any write so every server converges on the same
+        # peer set, and snapshot-carried so joiners learn it on catch-up.
+        self.raft_peers: List[str] = []
         self.scheduler_config = SchedulerConfiguration()
         # ACL tables (acl_policy/acl_token, nomad/state/schema.go).
         self.acl_policies: Dict[str, "ACLPolicy"] = {}
@@ -448,6 +462,12 @@ class StateStore:
                 for tg in job.task_groups:
                     summary.summary[tg.name] = {}
                 self.job_summaries[key] = summary
+            # Refresh the scaling-policy view for this job's groups.
+            for k in [p for p in self.scaling_policies if p[:2] == key]:
+                del self.scaling_policies[k]
+            for tg in job.task_groups:
+                if tg.scaling is not None:
+                    self.scaling_policies[key + (tg.name,)] = tg.scaling
             self._bump("jobs", index)
             self._publish(
                 "Job", "JobRegistered", job.id, job, index, job.namespace
@@ -482,6 +502,10 @@ class StateStore:
                 self.job_versions.pop(key, None)
                 self.job_summaries.pop(key, None)
                 self.periodic_launch.pop(key, None)
+                for k in [p for p in self.scaling_policies if p[:2] == key]:
+                    del self.scaling_policies[k]
+                for k in [p for p in self.scaling_events if p[:2] == key]:
+                    del self.scaling_events[k]
                 self._bump("jobs", index)
                 self._publish(
                     "Job", "JobDeregistered", job_id, None, index, namespace
@@ -911,6 +935,154 @@ class StateStore:
             self._bump("periodic_launch", index)
 
     # ------------------------------------------------------------------
+    # Volumes (csi_volumes table + claim tracking;
+    # nomad/csi_endpoint.go, nomad/state/state_store.go CSIVolumeRegister/
+    # CSIVolumeClaim — trimmed to the plugin-less host-volume analog)
+    # ------------------------------------------------------------------
+
+    # Validation MUST precede the @journaled inner mutators: the wrapper
+    # replicates + WAL-appends BEFORE calling fn, so a mutator that raises
+    # poisons the log (replay crash-loops; followers 500 the stream).
+    # Public entry points therefore validate under the canonical locks and
+    # only then enter the unconditional journaled twin.
+
+    def upsert_volume(self, index: int, volume: "Volume") -> None:
+        with self._write_lock, self._lock:
+            prev = self.volumes.get((volume.namespace, volume.id))
+            if prev is not None and (
+                prev.read_claims or prev.write_claims
+            ) and (
+                prev.access_mode != volume.access_mode
+                or prev.source != volume.source
+            ):
+                # The reference rejects re-registering an in-use volume
+                # with changed parameters — live claims were granted
+                # under the old contract.
+                raise ValueError(
+                    "volume is in use; access_mode/source cannot change"
+                )
+            self._upsert_volume(index, volume)
+
+    @journaled
+    def _upsert_volume(self, index: int, volume: "Volume") -> None:
+        with self._lock:
+            key = (volume.namespace, volume.id)
+            prev = self.volumes.get(key)
+            volume.modify_index = index
+            if prev is None:
+                volume.create_index = index
+            else:
+                volume.create_index = prev.create_index
+                # Claims survive a re-register (spec updates must not
+                # wipe attachment state).
+                volume.read_claims = dict(prev.read_claims)
+                volume.write_claims = dict(prev.write_claims)
+            self._push_history("volumes", key, prev)
+            self.volumes[key] = volume
+            self._bump("volumes", index)
+            self._publish(
+                "Volume", "VolumeRegistered", volume.id, volume, index,
+                volume.namespace,
+            )
+
+    def delete_volume(self, index: int, namespace: str, volume_id: str) -> None:
+        with self._write_lock, self._lock:
+            vol = self.volumes.get((namespace, volume_id))
+            if vol is None:
+                return
+            if vol.read_claims or vol.write_claims:
+                raise ValueError("volume is in use")
+            self._delete_volume(index, namespace, volume_id)
+
+    @journaled
+    def _delete_volume(self, index: int, namespace: str, volume_id: str) -> None:
+        with self._lock:
+            key = (namespace, volume_id)
+            vol = self.volumes.pop(key, None)
+            if vol is None:
+                return
+            self._push_history("volumes", key, vol)
+            self._bump("volumes", index)
+            self._publish(
+                "Volume", "VolumeDeregistered", volume_id, None, index,
+                namespace,
+            )
+
+    def claim_volume(
+        self, index: int, namespace: str, volume_id: str, alloc_id: str,
+        node_id: str, read_only: bool,
+    ) -> None:
+        with self._write_lock, self._lock:
+            if (namespace, volume_id) not in self.volumes:
+                raise ValueError(f"unknown volume {volume_id!r}")
+            self._claim_volume(
+                index, namespace, volume_id, alloc_id, node_id, read_only
+            )
+
+    @journaled
+    def _claim_volume(
+        self, index: int, namespace: str, volume_id: str, alloc_id: str,
+        node_id: str, read_only: bool,
+    ) -> None:
+        with self._lock:
+            vol = self.volumes.get((namespace, volume_id))
+            if vol is None:
+                return  # volume GC'd between journal and a late replay
+            table = vol.read_claims if read_only else vol.write_claims
+            table[alloc_id] = node_id
+            vol.modify_index = index
+            self._bump("volumes", index)
+
+    @journaled
+    def release_volume_claims(
+        self, index: int, namespace: str, volume_id: str,
+        alloc_ids: List[str],
+    ) -> None:
+        with self._lock:
+            vol = self.volumes.get((namespace, volume_id))
+            if vol is None:
+                return
+            for aid in alloc_ids:
+                vol.read_claims.pop(aid, None)
+                vol.write_claims.pop(aid, None)
+            vol.modify_index = index
+            self._bump("volumes", index)
+
+    def volume_by_id(self, namespace: str, volume_id: str) -> Optional["Volume"]:
+        return self.volumes.get((namespace, volume_id))
+
+    @journaled
+    def set_raft_peers(self, index: int, addrs: List[str]) -> None:
+        """Replace the replicated membership list (raft configuration
+        change).  Replicated with the OLD peer set (replicate-first order
+        in @journaled), then applied — so the entry commits under the
+        quorum that authorized it."""
+        with self._lock:
+            self.raft_peers = list(addrs)
+            self._bump("raft_peers", index)
+        rep = self.replicator
+        if rep is not None:
+            # Outside _lock: update_peers takes the replicator lock and
+            # the store lock must never be held when acquiring it in a
+            # path a reader could be blocked behind.
+            rep.update_peers(addrs)
+
+    @journaled
+    def record_scaling_event(
+        self, index: int, namespace: str, job_id: str, group: str,
+        event: "ScalingEvent",
+    ) -> None:
+        """Append to a group's scaling history (UpsertScalingEvent,
+        nomad/state/state_store.go; ring capped like JobTrackedScalingEvents)."""
+        with self._lock:
+            ring = self.scaling_events.setdefault(
+                (namespace, job_id, group), []
+            )
+            ring.append(event)
+            del ring[:-20]
+            self._bump("scaling_event", index)
+
+    # ------------------------------------------------------------------
     # Scheduler config (raft-held runtime knobs; structs/operator.go)
     # ------------------------------------------------------------------
 
@@ -1026,6 +1198,28 @@ class StateStore:
                     d2.status_description = upd.status_description
                     self.upsert_deployment(index, d2)
             self.upsert_allocs(index, stops + preemptions + allocs, now=now)
+            # Volume claims for newly placed allocs whose groups request
+            # registered volumes (CSIVolumeClaim at plan apply).  Derived
+            # from the same entry, so replication/replay reproduce claims
+            # without their own journal records.
+            for a in allocs:
+                job = a.job
+                tg = job.lookup_task_group(a.task_group) if job else None
+                if tg is None or not tg.volumes:
+                    continue
+                for vreq in tg.volumes.values():
+                    if vreq.type != "csi":
+                        continue
+                    vol = self.volumes.get((a.namespace, vreq.source))
+                    if vol is None:
+                        continue
+                    table = (
+                        vol.read_claims if vreq.read_only
+                        else vol.write_claims
+                    )
+                    table[a.id] = a.node_id
+                    vol.modify_index = index
+                    self._bump("volumes", index)
             if evals:
                 self.upsert_evals(index, evals)
 
@@ -1073,13 +1267,21 @@ class StateStore:
 
     def install_snapshot(self, snapshot_wire: dict, seq: int) -> None:
         """Replace ALL local state with the leader's FSM image (raft
-        InstallSnapshot): reset tables + matrix, restore, persist."""
-        with self._lock:
+        InstallSnapshot): reset tables + matrix, restore, persist.
+        Takes the canonical lock order (_write_lock → _lock): the restore
+        replays through mutators whose @journaled wrapper acquires
+        _write_lock — _lock alone here would invert and deadlock."""
+        with self._write_lock, self._lock:
             self._reset_tables_locked()
             self.restore(snapshot_wire, [])
             if self.wal is not None:
                 self.wal.seq = seq
                 self.wal.write_snapshot(self.to_snapshot_wire())
+        # A joiner learns the membership list from the image it was
+        # caught up with (outside _lock — see set_raft_peers).
+        rep = self.replicator
+        if rep is not None and self.raft_peers:
+            rep.update_peers(self.raft_peers)
 
     def _reset_tables_locked(self) -> None:
         self.matrix.clear()
@@ -1093,6 +1295,10 @@ class StateStore:
         self.deployments.clear()
         self.job_summaries.clear()
         self.periodic_launch.clear()
+        self.scaling_policies.clear()
+        self.scaling_events.clear()
+        self.raft_peers = []
+        self.volumes.clear()
         self._allocs_by_node.clear()
         self._allocs_by_job.clear()
         self._allocs_by_eval.clear()
@@ -1129,6 +1335,14 @@ class StateStore:
                     [ns, jid, t]
                     for (ns, jid), t in self.periodic_launch.items()
                 ],
+                "scaling_events": [
+                    [ns, jid, g, [serde.to_wire(e) for e in ring]]
+                    for (ns, jid, g), ring in self.scaling_events.items()
+                ],
+                "raft_peers": list(self.raft_peers),
+                "volumes": [
+                    serde.to_wire(v) for v in self.volumes.values()
+                ],
                 "scheduler_config": serde.to_wire(self.scheduler_config),
                 "acl_policies": [
                     serde.to_wire(p) for p in self.acl_policies.values()
@@ -1148,7 +1362,9 @@ class StateStore:
         snapshot image + WAL tail.  Must run before :meth:`attach_wal`."""
         from ..structs import serde
 
-        with self._lock:
+        # Canonical order (_write_lock → _lock): replayed mutators
+        # re-enter the journaled wrapper, which acquires _write_lock.
+        with self._write_lock, self._lock:
             self._replaying = True
             try:
                 if snapshot_wire:
@@ -1200,6 +1416,14 @@ class StateStore:
             dep.create_index = create
         for ns, jid, t in snap["periodic_launch"]:
             self.periodic_launch[(ns, jid)] = t
+        for ns, jid, g, ring in snap.get("scaling_events", []):
+            self.scaling_events[(ns, jid, g)] = [
+                serde.from_wire(w) for w in ring
+            ]
+        self.raft_peers = list(snap.get("raft_peers", []))
+        for w in snap.get("volumes", []):
+            v = serde.from_wire(w)
+            self.volumes[(v.namespace, v.id)] = v
         self.scheduler_config = serde.from_wire(snap["scheduler_config"])
         for w in snap.get("acl_policies", []):
             p = serde.from_wire(w)
@@ -1276,6 +1500,13 @@ class StateSnapshot:
 
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
         return self._at("evals", eval_id, self.store.evals.get(eval_id))
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._at("allocs", alloc_id, self.store.allocs.get(alloc_id))
+
+    def volume_by_id(self, namespace: str, volume_id: str):
+        key = (namespace, volume_id)
+        return self._at("volumes", key, self.store.volumes.get(key))
 
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
         return self._at(

@@ -330,6 +330,105 @@ class EphemeralDisk:
 
 
 @dataclass
+class VolumeRequest:
+    """A task group's volume ask (jobspec ``volume`` block; reference:
+    structs.VolumeRequest).  type "host" binds a node host_volumes entry by
+    name; type "csi" binds a registered Volume (structs.CSIVolume) whose
+    claims the control plane tracks."""
+
+    name: str = ""
+    type: str = "host"  # "host" | "csi"
+    source: str = ""
+    read_only: bool = False
+    per_alloc: bool = False
+
+
+@dataclass
+class VolumeMount:
+    """Task-level mount of a group volume (structs.VolumeMount)."""
+
+    volume: str = ""
+    destination: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Volume:
+    """A registered cluster volume — the CSI-volume analog without an
+    external plugin daemon (reference: structs.CSIVolume + csi_volumes
+    table, nomad/state/schema.go; claims nomad/csi_endpoint.go).
+
+    ``source`` names the host-volume entry nodes must expose; the
+    schedulability contract lives in ``access_mode`` + the claim tables."""
+
+    id: str = ""
+    name: str = ""
+    namespace: str = "default"
+    plugin_id: str = "host"
+    source: str = ""
+    access_mode: str = "single-node-writer"  # | multi-node-reader | multi-node-multi-writer
+    attachment_mode: str = "file-system"
+    capacity_mb: int = 0
+    # alloc_id -> node_id claim tables (CSIVolume.ReadAllocs/WriteAllocs).
+    read_claims: Dict[str, str] = field(default_factory=dict)
+    write_claims: Dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def __post_init__(self):
+        if not self.id:
+            self.id = generate_uuid()
+        if not self.name:
+            self.name = self.id
+        if not self.source:
+            self.source = self.name
+
+    def exclusive_writer(self) -> bool:
+        return self.access_mode == "single-node-writer"
+
+    def claimable(self, read_only: bool) -> bool:
+        """Can another alloc claim this volume now?  (WriteFreeClaims,
+        structs.CSIVolume).  Reader-only access modes never admit
+        writers."""
+        if read_only:
+            return True
+        if self.access_mode == "multi-node-multi-writer":
+            return True
+        if self.access_mode == "single-node-writer":
+            return not self.write_claims
+        return False
+
+
+@dataclass
+class ScalingPolicy:
+    """Horizontal group-count scaling bounds + autoscaler policy document
+    (reference: structs.ScalingPolicy, nomad/structs/structs.go; stored in
+    the scaling_policy table, nomad/state/schema.go:85-901).  Declared on
+    a task group (jobspec ``scaling`` block); enforced by Job.Scale."""
+
+    min: int = 0
+    max: int = 0
+    enabled: bool = True
+    # Opaque autoscaler configuration (cooldown, checks...) — carried, not
+    # interpreted, exactly like the reference.
+    policy: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ScalingEvent:
+    """One entry in a group's scaling history (structs.ScalingEvent;
+    scaling_event table)."""
+
+    time: float = 0.0
+    count: Optional[int] = None
+    previous_count: int = 0
+    message: str = ""
+    error: bool = False
+    eval_id: str = ""
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class PeriodicConfig:
     """Cron-style launch config (reference: structs.PeriodicConfig;
     nomad/periodic.go)."""
@@ -365,6 +464,13 @@ class Task:
     lifecycle_sidecar: bool = False
     artifacts: List[Dict[str, Any]] = field(default_factory=list)
     templates: List[Dict[str, Any]] = field(default_factory=list)
+    # Where a dispatched parameterized job's payload lands in the task dir
+    # (structs.DispatchPayloadConfig): {"file": "input.json"} → local/.
+    dispatch_payload: Optional[Dict[str, str]] = None
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    # Log rotation caps (structs.LogConfig; client/logmon/):
+    # {"max_files": N, "max_file_size_mb": M}.  None = defaults (10 x 10MB).
+    logs: Optional[Dict[str, int]] = None
 
 
 @dataclass
@@ -382,6 +488,8 @@ class TaskGroup:
     ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
     networks: List[NetworkResource] = field(default_factory=list)
     stop_after_client_disconnect: Optional[float] = None
+    scaling: Optional[ScalingPolicy] = None
+    volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
 
     def combined_resources(self) -> Resources:
         """Aggregate ask across tasks (+ ephemeral disk), the unit the fit
@@ -427,6 +535,10 @@ class Job:
     submit_time: float = 0.0
     parent_id: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    # Dispatch payload (base64; structs.Job.Payload) — set on the CHILD of
+    # a parameterized job by Job.Dispatch, written into the task dir by
+    # the dispatch-payload task hook.
+    payload: str = ""
 
     def __post_init__(self):
         if not self.id:

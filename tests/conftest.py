@@ -13,6 +13,11 @@ import os
 # bench.py runs on the real chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Widen every raft timer 2x: the defaults (0.15-0.5s elections, 50-80ms
+# heartbeats) flap when a loaded CI machine delays scheduler threads past
+# the election window (round-4 flake in test_writes_rejected_on_followers).
+os.environ.setdefault("NOMAD_TPU_RAFT_TIMEOUT_SCALE", "2.0")
+
 # Drop any registered TPU-tunnel backend factory: with the plugin registered,
 # jax initializes it even under JAX_PLATFORMS=cpu, and a wedged tunnel then
 # hangs every test (observed: make_c_api_client blocking forever).

@@ -21,6 +21,13 @@ from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.structs.types import AllocClientStatus, Task
 
 
+@pytest.fixture(autouse=True)
+def _python_sidecar(monkeypatch):
+    # This file covers the PYTHON sidecar; the native C++ one (preferred
+    # automatically when built) has its own suite, test_native_executor.py.
+    monkeypatch.setenv("NOMAD_TPU_EXECUTOR_BIN", "")
+
+
 @pytest.fixture
 def server():
     s = Server(ServerConfig(

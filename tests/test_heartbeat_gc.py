@@ -124,3 +124,69 @@ def test_client_gc_evicts_oldest_terminal_allocs(tmp_path):
     finally:
         c.shutdown()
         srv.shutdown()
+
+
+class TestHeartbeatStop:
+    def test_stop_after_client_disconnect(self, tmp_path):
+        """client/heartbeatstop.go: a partitioned client kills groups
+        that opted into stop_after_client_disconnect; others keep
+        running."""
+        from nomad_tpu import mock
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs.types import AllocClientStatus
+
+        # Server-side expiry must stay OUT of the picture (wide TTLs):
+        # this tests the CLIENT's disconnect policy; a 1s TTL lets a
+        # loaded machine mark the node down before the partition starts.
+        srv = Server(ServerConfig(
+            num_workers=2, heartbeat_min_ttl=60.0, heartbeat_max_ttl=90.0
+        ))
+        srv.start()
+        client = Client(srv, ClientConfig(data_dir=str(tmp_path / "c")))
+        client.start()
+        try:
+            def submit(stop_after):
+                job = mock.job()
+                tg = job.task_groups[0]
+                tg.count = 1
+                tg.stop_after_client_disconnect = stop_after
+                for t in tg.tasks:
+                    t.resources.cpu = 20
+                    t.resources.memory_mb = 32
+                tg.ephemeral_disk.size_mb = 10
+                ev = srv.submit_job(job)
+                srv.wait_for_eval(ev.id, timeout=90)
+                return job
+
+            stopping = submit(1.5)
+            surviving = submit(None)
+            for job in (stopping, surviving):
+                assert _wait(lambda j=job: any(
+                    a.client_status == AllocClientStatus.RUNNING.value
+                    for a in srv.store.allocs_by_job("default", j.id)
+                ), timeout=60)
+
+            # Partition: heartbeats start failing.
+            class Unreachable:
+                def __getattr__(self, name):
+                    def boom(*a, **kw):
+                        raise ConnectionError("partitioned")
+                    return boom
+
+            client.server = Unreachable()
+
+            stop_ar = next(
+                ar for ar in client.allocs.values()
+                if ar.alloc.job_id == stopping.id
+            )
+            live_ar = next(
+                ar for ar in client.allocs.values()
+                if ar.alloc.job_id == surviving.id
+            )
+            assert _wait(lambda: stop_ar.terminal, timeout=30)
+            assert not live_ar.terminal
+        finally:
+            client.server = srv
+            client.shutdown()
+            srv.shutdown()

@@ -239,7 +239,7 @@ class TestNodeDrainer:
             job = _small(mock.job())
             tg = job.task_groups[0]
             tg.count = 4
-            tg.migrate = MigrateStrategy(max_parallel=1)
+            tg.migrate_strategy = MigrateStrategy(max_parallel=1)
             ev = server.submit_job(job)
             server.wait_for_eval(ev.id, timeout=90)
             assert _wait(lambda: len([
@@ -290,7 +290,7 @@ class TestNodeDrainer:
             tg.count = 3
             # Pacing of 1 with a nearly-immediate deadline: the force path
             # must stamp everything at once.
-            tg.migrate = MigrateStrategy(max_parallel=1)
+            tg.migrate_strategy = MigrateStrategy(max_parallel=1)
             ev = server.submit_job(job)
             server.wait_for_eval(ev.id, timeout=90)
             assert _wait(lambda: len([

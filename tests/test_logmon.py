@@ -1,0 +1,124 @@
+"""Logmon — size-capped task log rotation (VERDICT r4 missing #7).
+
+Reference: client/logmon/ + logging/rotator.go (N files x M bytes).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.client.logmon import LogRotator, rotate_once
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.types import AllocClientStatus
+
+
+class TestRotator:
+    def test_rotate_once_shifts_and_truncates(self, tmp_path):
+        p = str(tmp_path / "t.stdout")
+        with open(p, "w") as fh:
+            fh.write("AAA")
+        rotate_once(p, max_files=3)
+        assert os.path.getsize(p) == 0
+        assert open(p + ".1").read() == "AAA"
+        with open(p, "w") as fh:
+            fh.write("BBB")
+        rotate_once(p, max_files=3)
+        assert open(p + ".1").read() == "BBB"
+        assert open(p + ".2").read() == "AAA"
+        # Third rotation drops the oldest (cap = 3 files incl. live).
+        with open(p, "w") as fh:
+            fh.write("CCC")
+        rotate_once(p, max_files=3)
+        assert open(p + ".1").read() == "CCC"
+        assert open(p + ".2").read() == "BBB"
+        assert not os.path.exists(p + ".3")
+
+    def test_o_append_writer_survives_truncate(self, tmp_path):
+        """The property copy-truncate depends on: an O_APPEND fd keeps
+        writing at the new EOF after truncation."""
+        p = str(tmp_path / "live")
+        fd = open(p, "ab")
+        fd.write(b"x" * 100)
+        fd.flush()
+        rotate_once(p, max_files=2)
+        fd.write(b"after")
+        fd.flush()
+        assert open(p, "rb").read() == b"after"
+        fd.close()
+
+    def test_rotator_caps_growth(self, tmp_path):
+        p = str(tmp_path / "chatty")
+        rot = LogRotator([p], max_file_bytes=4096, max_files=3,
+                         interval=0.05)
+        rot.start()
+        try:
+            with open(p, "ab") as fh:
+                for _ in range(200):
+                    fh.write(b"y" * 512)
+                    fh.flush()
+                    time.sleep(0.002)
+        finally:
+            rot.stop()
+        live = os.path.getsize(p)
+        rotated = glob.glob(p + ".*")
+        assert live <= 4096 + 512 * 40  # bounded, not 100KB
+        assert len(rotated) <= 2
+        total = live + sum(os.path.getsize(f) for f in rotated)
+        assert total < 200 * 512  # history capped below what was written
+
+
+class TestChattyTask:
+    def test_raw_exec_logs_stay_under_cap(self, tmp_path):
+        srv = Server(ServerConfig(
+            num_workers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ))
+        srv.start()
+        client = Client(srv, ClientConfig(data_dir=str(tmp_path / "c")))
+        client.start()
+        try:
+            job = mock.job()
+            job.type = "batch"
+            tg = job.task_groups[0]
+            tg.count = 1
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.resources.cpu = 20
+            task.resources.memory_mb = 32
+            tg.ephemeral_disk.size_mb = 10
+            # ~2 MB of output against a 64 KB x 2-file cap.
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c",
+                         "i=0; while [ $i -lt 2000 ]; do "
+                         "printf '%01000d\\n' $i; i=$((i+1)); done; "
+                         "sleep 1"],
+            }
+            task.logs = {"max_files": 2, "max_file_bytes": 65536}
+            ev = srv.submit_job(job)
+            srv.wait_for_eval(ev.id, timeout=90)
+            assert _wait(lambda: any(
+                a.terminal_status()
+                for a in srv.store.allocs_by_job("default", job.id)
+            ) and srv.store.allocs_by_job("default", job.id), timeout=60)
+            alloc = srv.store.allocs_by_job("default", job.id)[0]
+            stdout = os.path.join(
+                str(tmp_path / "c"), alloc.id, task.name,
+                f"{task.name}.stdout",
+            )
+            assert os.path.exists(stdout)
+            files = [stdout] + glob.glob(stdout + ".*")
+            total = sum(os.path.getsize(f) for f in files)
+            # The task wrote ~2 MB; the cap holds it to the live file + one
+            # rotated file (+ one burst window of slack).
+            assert total < 500_000, (total, files)
+            assert len(files) <= 2
+        finally:
+            client.shutdown()
+            srv.shutdown()

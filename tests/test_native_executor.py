@@ -1,0 +1,176 @@
+"""Native C++ executor sidecar (native/executor.cc): protocol parity with
+the Python sidecar — start/wait isolation, idempotent start, stop
+escalation, kill -9 recovery by pid.
+
+Reference analog: drivers/shared/executor/ (compiled supervisor behind a
+process boundary with reattach).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import time
+
+import pytest
+
+from helpers import _wait
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "native", "nomad-executor")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native")],
+        check=True, capture_output=True,
+    )
+    assert os.access(BIN, os.X_OK)
+
+
+@pytest.fixture
+def sidecar(tmp_path, monkeypatch):
+    from nomad_tpu.client.driver import SidecarClient
+
+    monkeypatch.setenv("NOMAD_TPU_EXECUTOR_BIN", BIN)
+    sc = SidecarClient(str(tmp_path))
+    sc.ensure_running()
+    out = sc.call("ping")
+    assert out.get("native") is True  # actually the C++ binary
+    yield sc
+    try:
+        sc.call("shutdown")
+    except Exception:  # noqa: BLE001 — it exits on shutdown
+        pass
+
+
+class TestNativeExecutor:
+    def _start(self, sc, tmp_path, tid, argv, **kw):
+        return sc.call(
+            "start", id=tid, argv=argv, env={"NATIVE": "1"},
+            cwd=str(tmp_path),
+            stdout=str(tmp_path / f"{tid}.stdout"),
+            stderr=str(tmp_path / f"{tid}.stderr"),
+            **kw,
+        )
+
+    def test_start_wait_output_env_exit(self, sidecar, tmp_path):
+        out = self._start(
+            sidecar, tmp_path, "t1",
+            ["/bin/sh", "-c", "echo out-$NATIVE; echo err >&2; exit 4"],
+        )
+        assert out["pid"] > 0
+        assert _wait(lambda: not sidecar.call("wait", id="t1").get(
+            "running"
+        ), timeout=15)
+        res = sidecar.call("wait", id="t1")
+        assert res["exit_code"] == 4 and res["signal"] == 0
+        assert (tmp_path / "t1.stdout").read_text() == "out-1\n"
+        assert (tmp_path / "t1.stderr").read_text() == "err\n"
+
+    def test_start_idempotent(self, sidecar, tmp_path):
+        a = self._start(sidecar, tmp_path, "t2", ["/bin/sleep", "30"])
+        b = self._start(sidecar, tmp_path, "t2", ["/bin/sleep", "30"])
+        assert a["pid"] == b["pid"]
+        sidecar.call("destroy", id="t2")
+
+    def test_stop_escalates(self, sidecar, tmp_path):
+        # A trap-ignoring task: SIGTERM does nothing, the grace timer's
+        # SIGKILL must end it.
+        self._start(
+            sidecar, tmp_path, "t3",
+            ["/bin/sh", "-c", "trap '' TERM; sleep 60"],
+        )
+        time.sleep(0.2)
+        sidecar.call("stop", id="t3", grace=0.5)
+        assert _wait(lambda: not sidecar.call("wait", id="t3").get(
+            "running"
+        ), timeout=15)
+        res = sidecar.call("wait", id="t3")
+        assert res["signal"] == signal.SIGKILL
+
+    def test_kill9_sidecar_recovery(self, sidecar, tmp_path):
+        """kill -9 the NATIVE sidecar: the task (own session) survives;
+        a replacement recovers it by pid and observes its exit."""
+        from nomad_tpu.client.driver import SidecarClient
+
+        marker = tmp_path / "survived.txt"
+        self._start(
+            sidecar, tmp_path, "t4",
+            ["/bin/sh", "-c",
+             f"sleep 2; echo alive > {marker}; sleep 1"],
+        )
+        victim_pid = sidecar._proc.pid
+        os.kill(victim_pid, signal.SIGKILL)
+        time.sleep(0.3)
+        # The SidecarClient transparently respawns + recovers on the next
+        # non-start call.
+        out = sidecar.call("list")
+        assert "t4" in out["tasks"]
+        assert _wait(lambda: not sidecar.call("wait", id="t4").get(
+            "running"
+        ), timeout=20)
+        res = sidecar.call("wait", id="t4")
+        assert res.get("recovered") is True
+        assert marker.exists()  # kept running across the sidecar's death
+
+    def test_rlimits_applied(self, sidecar, tmp_path):
+        # RLIMIT_FSIZE 1024: writing >1KB must fail the task (SIGXFSZ).
+        self._start(
+            sidecar, tmp_path, "t5",
+            ["/bin/sh", "-c",
+             "dd if=/dev/zero of=big.bin bs=4096 count=10 2>/dev/null"],
+            rlimits={"fsize": 1024},
+        )
+        assert _wait(lambda: not sidecar.call("wait", id="t5").get(
+            "running"
+        ), timeout=15)
+        res = sidecar.call("wait", id="t5")
+        assert res["signal"] == signal.SIGXFSZ or res["exit_code"] != 0
+
+
+class TestExecDriverOnNative:
+    def test_exec_driver_end_to_end(self, tmp_path, monkeypatch):
+        """The exec driver runs a real task through the NATIVE sidecar."""
+        from nomad_tpu import mock
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs.types import AllocClientStatus, Task
+
+        monkeypatch.setenv("NOMAD_TPU_EXECUTOR_BIN", BIN)
+        srv = Server(ServerConfig(
+            num_workers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ))
+        srv.start()
+        client = Client(srv, ClientConfig(data_dir=str(tmp_path / "c")))
+        client.start()
+        try:
+            job = mock.job()
+            job.type = "batch"
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.ephemeral_disk.size_mb = 10
+            tg.tasks = [Task(
+                name="main", driver="exec",
+                config={"command": "/bin/sh",
+                        "args": ["-c", "echo native-exec; exit 0"]},
+            )]
+            tg.tasks[0].resources.cpu = 20
+            tg.tasks[0].resources.memory_mb = 32
+            ev = srv.submit_job(job)
+            srv.wait_for_eval(ev.id, timeout=90)
+            assert _wait(lambda: any(
+                a.client_status == AllocClientStatus.COMPLETE.value
+                for a in srv.store.allocs_by_job("default", job.id)
+            ), timeout=60)
+            alloc = srv.store.allocs_by_job("default", job.id)[0]
+            out = tmp_path / "c" / alloc.id / "main" / "main.stdout"
+            assert out.read_text() == "native-exec\n"
+        finally:
+            client.shutdown()
+            srv.shutdown()

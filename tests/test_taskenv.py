@@ -109,7 +109,11 @@ def test_task_sees_nomad_env_end_to_end(server, tmp_path):
 def test_artifact_and_template_hooks(server, tmp_path):
     src = tmp_path / "payload.txt"
     src.write_text("artifact-content")
-    c = Client(server, ClientConfig(data_dir=str(tmp_path / "c")))
+    # file:// sources are sandboxed (ADVICE r4: a submit-job token must
+    # not read arbitrary agent files) — allowlist the fixture dir.
+    c = Client(server, ClientConfig(
+        data_dir=str(tmp_path / "c"), artifact_root=str(tmp_path)
+    ))
     c.start()
     try:
         job = mock.job()

@@ -1,0 +1,304 @@
+"""Volume lifecycle (VERDICT r4 missing #4 — CSI-equivalent without
+external plugin daemons): registration + claim tracking in state, claim
+release on terminal allocs (volume watcher), scheduler feasibility against
+claims, per-alloc mount plumbing, and /v1/volumes CRUD.
+
+Reference: nomad/csi_endpoint.go, nomad/volumewatcher/volumes_watcher.go,
+nomad/state/schema.go csi_volumes table, client volume_hook.go.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu import mock
+from nomad_tpu.api.client import APIClient, APIError
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.types import (
+    AllocClientStatus,
+    EvalStatus,
+    Volume,
+    VolumeMount,
+    VolumeRequest,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(
+        num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+    ))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _client(server, tmp_path, name, host_volumes=None) -> Client:
+    c = Client(server, ClientConfig(data_dir=str(tmp_path / name)))
+    if host_volumes:
+        c.node.host_volumes = dict(host_volumes)
+    c.start()
+    return c
+
+
+def _vol_job(vol_id, read_only=False, count=1, mount=False):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    for t in tg.tasks:
+        t.resources.cpu = 20
+        t.resources.memory_mb = 32
+    tg.ephemeral_disk.size_mb = 10
+    tg.volumes = {
+        "data": VolumeRequest(
+            name="data", type="csi", source=vol_id, read_only=read_only
+        )
+    }
+    if mount:
+        tg.tasks[0].volume_mounts = [
+            VolumeMount(volume="data", destination="data")
+        ]
+    return job
+
+
+class TestVolumeState:
+    def test_register_claim_release_roundtrip(self, server):
+        store = server.store
+        vol = Volume(id="vol1", source="disk1")
+        store.upsert_volume(server.next_index(), vol)
+        assert store.volume_by_id("default", "vol1") is vol
+
+        store.claim_volume(
+            server.next_index(), "default", "vol1", "alloc-1", "node-1",
+            read_only=False,
+        )
+        with pytest.raises(ValueError):
+            store.delete_volume(server.next_index(), "default", "vol1")
+        store.release_volume_claims(
+            server.next_index(), "default", "vol1", ["alloc-1"]
+        )
+        store.delete_volume(server.next_index(), "default", "vol1")
+        assert store.volume_by_id("default", "vol1") is None
+
+    def test_reregister_preserves_claims(self, server):
+        store = server.store
+        store.upsert_volume(server.next_index(), Volume(id="v", source="s"))
+        store.claim_volume(
+            server.next_index(), "default", "v", "a1", "n1", read_only=False
+        )
+        store.upsert_volume(
+            server.next_index(), Volume(id="v", source="s", capacity_mb=10)
+        )
+        vol = store.volume_by_id("default", "v")
+        assert vol.capacity_mb == 10
+        assert vol.write_claims == {"a1": "n1"}
+        # ...but the CONTRACT cannot change while claims are live: a new
+        # access_mode or source under a held claim is rejected.
+        with pytest.raises(ValueError):
+            store.upsert_volume(server.next_index(), Volume(
+                id="v", source="s", access_mode="multi-node-multi-writer",
+            ))
+        with pytest.raises(ValueError):
+            store.upsert_volume(
+                server.next_index(), Volume(id="v", source="other")
+            )
+        with pytest.raises(ValueError):
+            store.claim_volume(
+                server.next_index(), "default", "nope", "a2", "n1",
+                read_only=False,
+            )
+
+    def test_volume_ops_survive_wal_replay(self, tmp_path):
+        """Rejected mutations (in-use delete, contract change) must never
+        reach the WAL: a journaled-then-raised entry would crash-loop
+        replay (validation precedes the journaled twin)."""
+        from nomad_tpu.server import Server, ServerConfig
+
+        cfg = ServerConfig(
+            num_workers=0, heartbeat_min_ttl=60, heartbeat_max_ttl=90,
+            data_dir=str(tmp_path / "srv"),
+        )
+        srv = Server(cfg)
+        srv.start()
+        try:
+            store = srv.store
+            store.upsert_volume(
+                srv.next_index(), Volume(id="v1", source="s1")
+            )
+            store.claim_volume(
+                srv.next_index(), "default", "v1", "a1", "n1",
+                read_only=False,
+            )
+            with pytest.raises(ValueError):
+                store.delete_volume(srv.next_index(), "default", "v1")
+            store.release_volume_claims(
+                srv.next_index(), "default", "v1", ["a1"]
+            )
+            store.upsert_volume(
+                srv.next_index(), Volume(id="v2", source="s2")
+            )
+            store.delete_volume(srv.next_index(), "default", "v2")
+        finally:
+            srv.shutdown()
+
+        # Restart: replay must reconstruct v1 (with released claims), no
+        # v2, and not raise.
+        srv2 = Server(ServerConfig(
+            num_workers=0, heartbeat_min_ttl=60, heartbeat_max_ttl=90,
+            data_dir=str(tmp_path / "srv"),
+        ))
+        srv2.start()
+        try:
+            vol = srv2.store.volume_by_id("default", "v1")
+            assert vol is not None
+            assert not vol.write_claims
+            assert srv2.store.volume_by_id("default", "v2") is None
+        finally:
+            srv2.shutdown()
+
+
+class TestExclusiveSerialization:
+    def test_two_jobs_contending_serialize(self, server, tmp_path):
+        """The DONE criterion: two jobs wanting the same single-node-writer
+        volume must not run concurrently — the second blocks until the
+        first's alloc is terminal and the volume watcher releases its
+        claim."""
+        client = _client(
+            server, tmp_path, "c1", host_volumes={"disk1": str(tmp_path)}
+        )
+        try:
+            server.store.upsert_volume(
+                server.next_index(), Volume(id="vol1", source="disk1")
+            )
+
+            job1 = _vol_job("vol1")
+            job1.task_groups[0].tasks[0].config = {"run_for": 3.0}
+            job1.type = "batch"
+            ev1 = server.submit_job(job1)
+            server.wait_for_eval(ev1.id, timeout=90)
+            assert _wait(lambda: any(
+                a.client_status == AllocClientStatus.RUNNING.value
+                for a in server.store.allocs_by_job("default", job1.id)
+            ), timeout=60)
+            vol = server.store.volume_by_id("default", "vol1")
+            assert len(vol.write_claims) == 1
+
+            # Second writer job: placement must FAIL (blocked eval).
+            job2 = _vol_job("vol1")
+            job2.task_groups[0].tasks[0].config = {"run_for": 0.1}
+            ev2 = server.submit_job(job2)
+            done2 = server.wait_for_eval(ev2.id, timeout=90)
+            assert done2.status == EvalStatus.COMPLETE.value
+            assert not server.store.allocs_by_job("default", job2.id)
+            assert server.blocked_evals.blocked_count() >= 1
+
+            # job1 finishes → watcher releases the claim → job2 unblocks
+            # and places.
+            assert _wait(lambda: bool(
+                server.store.allocs_by_job("default", job2.id)
+            ), timeout=90), server.store.volume_by_id("default", "vol1")
+        finally:
+            client.shutdown()
+
+    def test_readers_share(self, server, tmp_path):
+        client = _client(
+            server, tmp_path, "c1", host_volumes={"disk1": str(tmp_path)}
+        )
+        try:
+            server.store.upsert_volume(
+                server.next_index(),
+                Volume(id="vol1", source="disk1"),
+            )
+            j1 = _vol_job("vol1", read_only=True)
+            j2 = _vol_job("vol1", read_only=True)
+            for j in (j1, j2):
+                ev = server.submit_job(j)
+                server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(lambda: all(
+                server.store.allocs_by_job("default", j.id)
+                for j in (j1, j2)
+            ), timeout=60)
+            vol = server.store.volume_by_id("default", "vol1")
+            assert _wait(lambda: len(server.store.volume_by_id(
+                "default", "vol1"
+            ).read_claims) == 2, timeout=30)
+            assert not vol.write_claims
+        finally:
+            client.shutdown()
+
+    def test_missing_volume_blocks(self, server, tmp_path):
+        client = _client(server, tmp_path, "c1")
+        try:
+            job = _vol_job("nope")
+            ev = server.submit_job(job)
+            done = server.wait_for_eval(ev.id, timeout=90)
+            assert done.status == EvalStatus.COMPLETE.value
+            assert not server.store.allocs_by_job("default", job.id)
+        finally:
+            client.shutdown()
+
+
+class TestMountPlumbing:
+    def test_host_path_linked_into_task_dir(self, server, tmp_path):
+        host_dir = tmp_path / "exported"
+        host_dir.mkdir()
+        (host_dir / "hello.txt").write_text("from the volume")
+        client = _client(
+            server, tmp_path, "c1",
+            host_volumes={"disk1": str(host_dir)},
+        )
+        try:
+            server.store.upsert_volume(
+                server.next_index(), Volume(id="vol1", source="disk1")
+            )
+            job = _vol_job("vol1", mount=True)
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(lambda: any(
+                a.client_status == AllocClientStatus.RUNNING.value
+                for a in server.store.allocs_by_job("default", job.id)
+            ), timeout=60)
+            alloc = server.store.allocs_by_job("default", job.id)[0]
+            ar = client.allocs[alloc.id]
+            link = os.path.join(
+                ar.alloc_dir, job.task_groups[0].tasks[0].name, "data"
+            )
+            assert os.path.islink(link)
+            with open(os.path.join(link, "hello.txt")) as fh:
+                assert fh.read() == "from the volume"
+        finally:
+            client.shutdown()
+
+
+class TestVolumeHTTP:
+    def test_crud_over_http(self, tmp_path):
+        from nomad_tpu.api import Agent, AgentConfig
+
+        a = Agent(AgentConfig(
+            server_config=ServerConfig(
+                num_workers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+            ),
+            client_config=ClientConfig(data_dir=str(tmp_path / "c")),
+        ))
+        a.start()
+        try:
+            api = APIClient(a.rpc_addr)
+            out = api.register_volume({
+                "ID": "shared", "Source": "disk9",
+                "AccessMode": "multi-node-reader",
+            })
+            assert out["ID"] == "shared"
+            vols = api.list_volumes()
+            assert [v["id"] for v in vols] == ["shared"]
+            got = api.get_volume("shared")
+            assert got["source"] == "disk9"
+            api.deregister_volume("shared")
+            with pytest.raises(APIError):
+                api.get_volume("shared")
+        finally:
+            a.shutdown()
