@@ -125,3 +125,11 @@ def apply_config(doc: Dict, agent_config) -> None:
                 for b in bodies:
                     if isinstance(b, dict) and b.get("path"):
                         cc.host_volumes[name] = str(b["path"])
+        # plugin "name" { binary = "/path" } blocks (external drivers).
+        pl = cli.get("plugin")
+        if isinstance(pl, dict):
+            for name, body in pl.items():
+                bodies = body if isinstance(body, list) else [body]
+                for b in bodies:
+                    if isinstance(b, dict) and b.get("binary"):
+                        cc.plugins[name] = {"binary": str(b["binary"])}

@@ -96,6 +96,16 @@ class HTTPAPIServer:
                             self, parsed.path, query, token=fs_token
                         )
                         return
+                    if parsed.path in ("/", "/ui") and method == "GET":
+                        # Minimal operator dashboard (api/ui.py) — the
+                        # reference serves its Ember SPA the same way.
+                        from .ui import UI_HTML
+
+                        api._raw_respond(
+                            self, 200, UI_HTML.encode(),
+                            "text/html; charset=utf-8",
+                        )
+                        return
                     if parsed.path.startswith("/v1/client/exec/") and (
                         method in ("POST", "PUT")
                     ):
@@ -790,6 +800,26 @@ class HTTPAPIServer:
         token: str = "", cluster_secret: str = "",
     ) -> Any:
         server = self.agent.server
+        # Client-local surface: served by any agent running a client,
+        # including client-only agents with no server to route through.
+        if path == "/v1/client/stats" and method == "GET":
+            if self.agent.client is None:
+                raise HTTPError(501, "agent is not running a client")
+            if server is not None and server.config.acl_enabled:
+                acl = server.resolve_token(token)
+                if acl is None or not acl.allow_node("read"):
+                    raise HTTPError(403, "Permission denied (node:read)")
+            elif self.agent.client is not None and server is None:
+                try:
+                    if not self.agent.client.server.check_acl_capability(
+                        token, "node", "read"
+                    ):
+                        raise HTTPError(403, "Permission denied (node:read)")
+                except HTTPError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — fail closed
+                    raise HTTPError(502, f"ACL check unavailable: {exc}")
+            return self.agent.client.host_stats()
         if server is None:
             raise HTTPError(501, "agent is not running a server")
         store = server.store

@@ -12,6 +12,7 @@ update-allocs).
 from __future__ import annotations
 
 import logging
+import os
 import tempfile
 import threading
 import time
@@ -64,6 +65,15 @@ class ClientConfig:
     # name -> host path.  Feasibility (HostVolumeChecker) and the volume
     # mount hook resolve against these.
     host_volumes: Dict[str, str] = field(default_factory=dict)
+    # Periodic re-fingerprint cadence (client/fingerprint_manager.go):
+    # drifting facts (disk space, accelerator env, driver health) are
+    # re-detected and pushed to the server.  0 disables.
+    fingerprint_interval: float = 60.0
+    # External driver plugins (client plugin "name" { binary = ... }
+    # blocks): name -> {"binary": path}.  Dispensed into the driver
+    # registry at boot (go-plugin analog; client/driver.py
+    # ExternalPluginDriver).
+    plugins: Dict[str, Dict[str, str]] = field(default_factory=dict)
 
 
 class Client:
@@ -80,6 +90,13 @@ class Client:
         self.data_dir = self.config.data_dir or tempfile.mkdtemp(
             prefix="nomad_tpu_client_"
         )
+        # Dispense external driver plugins (go-plugin analog) with their
+        # sidecar state rooted in this client's data dir.
+        for pname, spec in (self.config.plugins or {}).items():
+            if spec.get("binary"):
+                self.drivers.register_plugin(
+                    pname, spec["binary"], state_dir=self.data_dir
+                )
         # Restart-recovery state (client/state/state_database.go analog).
         from .state import ClientStateDB
 
@@ -141,6 +158,7 @@ class Client:
             (self._heartbeat_loop, "heartbeat"),
             (self._watch_allocations, "watch-allocs"),
             (self._update_loop, "update-allocs"),
+            (self._fingerprint_loop, "fingerprint"),
         ):
             t = threading.Thread(
                 target=target, name=f"client-{name}-{self.node.id[:8]}",
@@ -221,6 +239,78 @@ class Client:
                     log.warning("heartbeat failed; servers unreachable",
                                 exc_info=True)
             self._heartbeat_stop_check()
+
+    def _fingerprint_loop(self) -> None:
+        """Periodic re-fingerprint (client/fingerprint_manager.go): when a
+        detected fact changes — free disk, accelerator env, driver health —
+        the node re-registers so schedulers see current truth."""
+        interval = self.config.fingerprint_interval
+        if not interval:
+            return
+        import copy as _copy
+
+        while not self._shutdown.wait(timeout=interval):
+            try:
+                attrs, resources = fingerprint()
+                attrs.update(self.drivers.fingerprint())
+                # Preserve agent-stamped attributes (advertise addr).
+                for k, v in self.node.attributes.items():
+                    if k.startswith("nomad."):
+                        attrs[k] = v
+                changed = (
+                    attrs != self.node.attributes
+                    or resources.devices != self.node.resources.devices
+                    # Capacity facts only — disk free drifts constantly
+                    # and is already reported coarsely.
+                    or resources.cpu != self.node.resources.cpu
+                    or resources.memory_mb != self.node.resources.memory_mb
+                )
+                if changed:
+                    self.node.attributes = attrs
+                    self.node.resources.devices = resources.devices
+                    self.node.resources.cpu = resources.cpu
+                    self.node.resources.memory_mb = resources.memory_mb
+                    self._ttl = self.server.register_node(
+                        _copy.deepcopy(self.node)
+                    ) or self._ttl
+                    log.info("re-fingerprint: node facts changed; "
+                             "re-registered")
+            except Exception:  # noqa: BLE001
+                log.debug("re-fingerprint failed", exc_info=True)
+
+    def host_stats(self) -> Dict:
+        """Host + device stats for /v1/client/stats (the ClientStats RPC,
+        nomad/client_rpc.go forwarding -> client host stats)."""
+        import shutil as _shutil
+
+        la1, la5, la15 = os.getloadavg() if hasattr(os, "getloadavg") else (
+            0.0, 0.0, 0.0
+        )
+        du = _shutil.disk_usage(self.data_dir)
+        mem_total = self.node.resources.memory_mb * 1024 * 1024
+        mem_avail = None
+        try:
+            with open("/proc/meminfo") as fh:
+                for line in fh:
+                    if line.startswith("MemAvailable:"):
+                        mem_avail = int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        return {
+            "Timestamp": time.time(),
+            "CPU": {"LoadAvg1": la1, "LoadAvg5": la5, "LoadAvg15": la15,
+                    "Cores": int(self.node.attributes.get(
+                        "cpu.numcores", "1"
+                    ))},
+            "Memory": {"Total": mem_total, "Available": mem_avail},
+            "DataDir": {"Total": du.total, "Free": du.free},
+            "Devices": {
+                name: list(ids)
+                for name, ids in self.node.resources.devices.items()
+            },
+            "AllocCount": len(self.allocs),
+        }
 
     def _heartbeat_stop_check(self) -> None:
         """Disconnected-client policy (client/heartbeatstop.go): a group

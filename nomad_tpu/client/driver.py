@@ -341,10 +341,13 @@ class SidecarClient:
     — spawn a replacement and hand it the dead one's task table to
     recover by pid (reattach-config semantics)."""
 
-    def __init__(self, state_dir: str):
+    def __init__(self, state_dir: str, binary: Optional[str] = None):
         self.state_dir = state_dir
         self.sock_path = os.path.join(state_dir, "executor.sock")
         self.state_path = os.path.join(state_dir, "executor.state.json")
+        # Explicit supervisor binary (external driver plugins); None =
+        # auto (native/nomad-executor when built, Python fallback).
+        self.binary = binary
         self._lock = threading.Lock()
         self._proc: Optional[subprocess.Popen] = None
 
@@ -409,7 +412,10 @@ class SidecarClient:
         # protocol and is preferred when built; the Python sidecar is the
         # always-available fallback.  NOMAD_TPU_EXECUTOR_BIN overrides
         # (empty string forces Python).
-        native = os.environ.get("NOMAD_TPU_EXECUTOR_BIN")
+        native = (
+            self.binary if self.binary is not None
+            else os.environ.get("NOMAD_TPU_EXECUTOR_BIN")
+        )
         if native is None:
             candidate = os.path.join(
                 os.path.dirname(os.path.dirname(
@@ -470,6 +476,10 @@ class ExecDriver(Driver):
     """
 
     name = "exec"
+    # Subdir of the client data dir holding this driver's sidecar state;
+    # None binary = auto-select (native build, Python fallback).
+    sidecar_subdir = "executor"
+    binary: Optional[str] = None
 
     def __init__(self, state_dir: str = ""):
         self._state_dir = state_dir
@@ -481,9 +491,14 @@ class ExecDriver(Driver):
             if self._sidecar is None:
                 sd = self._state_dir or state_dir
                 if not sd:
-                    raise DriverError("exec driver has no state dir yet")
+                    raise DriverError(
+                        f"{self.name} driver has no state dir yet"
+                    )
                 self._state_dir = sd
-                self._sidecar = SidecarClient(os.path.join(sd, "executor"))
+                self._sidecar = SidecarClient(
+                    os.path.join(sd, self.sidecar_subdir),
+                    binary=self.binary,
+                )
                 self._sidecar.ensure_running()
             return self._sidecar
 
@@ -593,6 +608,61 @@ class ExecDriver(Driver):
                 self._sidecar = None
 
 
+class ExternalPluginDriver(ExecDriver):
+    """An operator-supplied task driver running as its OWN supervisor
+    process — the go-plugin dispense analog (plugins/base/proto +
+    plugins/drivers/proto): the agent spawns the configured binary and
+    speaks the executor JSON-lines protocol to it (start/wait/stop/
+    destroy/recover/list, plus an optional ``info`` op for
+    name/version/config-schema discovery).  ``native/executor.cc`` and
+    ``client/executor.py`` double as reference plugin implementations.
+
+    Plugin config (client ``plugin "name" { binary = ... }`` blocks):
+    the binary must accept ``--socket PATH --state-dir DIR``.
+    """
+
+    def __init__(self, name: str, binary: str, state_dir: str = ""):
+        super().__init__(state_dir)
+        self.name = name
+        self.binary = binary
+        self.sidecar_subdir = f"plugin-{name}"
+        self._info: Optional[Dict[str, Any]] = None
+
+    def info(self, state_dir: str = "") -> Dict[str, Any]:
+        """PluginInfo + ConfigSchema (plugins/base/proto/base.proto):
+        optional — a plugin without the op reports bare detection.
+        Transient spawn failures are NOT cached (retried next call)."""
+        if self._info is None:
+            try:
+                self._info = self._get_sidecar(state_dir).call("info")
+            except (DriverError, OSError):
+                return {}
+        return self._info
+
+    def fingerprint(self) -> Dict[str, str]:
+        """Called at client boot + every re-fingerprint pass — this is
+        where the plugin is dispensed and its info discovered."""
+        info = self.info()
+        attrs = {f"driver.{self.name}": "1"}
+        version = info.get("version")
+        if version:
+            attrs[f"driver.{self.name}.version"] = str(version)
+        return attrs
+
+    def start_task(self, handle: TaskHandle, task: Task, task_dir: str) -> None:
+        # Schema-validate the task's config {} against what the plugin
+        # declared (hclspec analog, trimmed to required-key checking).
+        state_dir = os.path.dirname(os.path.dirname(task_dir))
+        schema = self.info(state_dir).get("config_schema") or {}
+        required = schema.get("required") or []
+        missing = [k for k in required if k not in (task.config or {})]
+        if missing:
+            raise DriverError(
+                f"plugin {self.name!r} requires config keys {missing}"
+            )
+        super().start_task(handle, task, task_dir)
+
+
 class DriverRegistry:
     """Per-client driver instances (reference: client/pluginmanager/
     drivermanager — dispense + fingerprint)."""
@@ -603,6 +673,14 @@ class DriverRegistry:
             "raw_exec": RawExecDriver(),
             "exec": ExecDriver(),
         }
+
+    def register_plugin(
+        self, name: str, binary: str, state_dir: str = ""
+    ) -> None:
+        """Dispense an external driver plugin (drivermanager dispense)."""
+        self.drivers[name] = ExternalPluginDriver(
+            name, binary, state_dir=state_dir
+        )
 
     def get(self, name: str) -> Driver:
         d = self.drivers.get(name)

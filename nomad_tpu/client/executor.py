@@ -94,6 +94,16 @@ class ExecutorServer:
     def op_ping(self, req):
         return {"pong": True, "pid": os.getpid()}
 
+    def op_info(self, req):
+        """PluginInfo + ConfigSchema (plugins/base/proto/base.proto):
+        lets the agent's plugin manager discover what it dispensed."""
+        return {
+            "name": "exec-executor",
+            "version": "1.0",
+            "protocol": "jsonl/1",
+            "config_schema": {"required": ["command"]},
+        }
+
     def op_start(self, req):
         # Idempotent by task id: a retried start (lost response) must not
         # launch a second copy.

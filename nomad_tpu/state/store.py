@@ -337,6 +337,16 @@ class StateStore:
                 node.create_index = index
             else:
                 node.create_index = prev.create_index
+                # Registration carries the CLIENT's facts; operator state
+                # is server-owned and survives re-registration (the
+                # reference's Node.Register preserves drain/eligibility/
+                # status, node_endpoint.go) — otherwise a periodic
+                # re-fingerprint would silently cancel a drain or
+                # resurrect a down-marked node.
+                node.drain = prev.drain
+                node.drain_strategy = prev.drain_strategy
+                node.scheduling_eligibility = prev.scheduling_eligibility
+                node.status = prev.status
             self._push_history("nodes", node.id, prev)
             self.nodes[node.id] = node
             self.matrix.upsert_node(node)

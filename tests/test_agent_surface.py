@@ -211,3 +211,13 @@ def test_cli_acl_namespace_search(tmp_path):
         assert "searchable-job" in out.stdout, out.stdout + out.stderr
     finally:
         a.shutdown()
+
+
+class TestWebUI:
+    def test_ui_served(self, agent):
+        with urllib.request.urlopen(agent.rpc_addr + "/ui") as resp:
+            body = resp.read().decode()
+        assert resp.headers["Content-Type"].startswith("text/html")
+        assert "nomad_tpu" in body and "/v1/jobs" in body
+        with urllib.request.urlopen(agent.rpc_addr + "/") as resp:
+            assert b"<!doctype html>" in resp.read()
