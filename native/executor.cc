@@ -477,6 +477,22 @@ static Json op_wait(const Json& req) {
   return out;
 }
 
+static Json op_signal(const Json& req) {
+  std::shared_ptr<Sup> sup;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_tasks.find(req.s("id"));
+    if (it != g_tasks.end()) sup = it->second;
+  }
+  Json out = Json::O();
+  if (!sup || sup->done) {
+    out.obj["error"] = Json::S("unknown or finished task");
+    return out;
+  }
+  kill_group(sup->pid, (int)req.n("signal", SIGTERM));
+  return Json::O();
+}
+
 static Json op_stop(const Json& req) {
   std::shared_ptr<Sup> sup;
   {
@@ -580,6 +596,8 @@ static void handle_conn(int fd) {
         out = op_wait(req);
       } else if (op == "stop") {
         out = op_stop(req);
+      } else if (op == "signal") {
+        out = op_signal(req);
       } else if (op == "destroy") {
         out = op_destroy(req);
       } else if (op == "recover") {

@@ -337,6 +337,20 @@ class APIClient:
     def get_allocation(self, alloc_id: str) -> Dict:
         return self._call("GET", f"/v1/allocation/{alloc_id}")
 
+    def restart_allocation(self, alloc_id: str, task: str = "") -> Dict:
+        return self._call(
+            "POST", f"/v1/client/allocation/{alloc_id}/restart",
+            {"Task": task},
+        )
+
+    def signal_allocation(
+        self, alloc_id: str, signal: str = "SIGTERM", task: str = ""
+    ) -> Dict:
+        return self._call(
+            "POST", f"/v1/client/allocation/{alloc_id}/signal",
+            {"Signal": signal, "Task": task},
+        )
+
     def stop_allocation(self, alloc_id: str) -> Dict:
         return self._call("PUT", f"/v1/allocation/{alloc_id}/stop")
 

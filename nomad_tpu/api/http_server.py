@@ -684,23 +684,7 @@ class HTTPAPIServer:
         import urllib.error
         import urllib.request
 
-        server = self.agent.server
-        if server is None:
-            raise HTTPError(404, f"allocation {alloc_id} not on this agent")
-        alloc = server.store.alloc_by_id(alloc_id)
-        if alloc is None:
-            raise HTTPError(404, f"unknown allocation {alloc_id}")
-        from ..state.matrix import node_attributes
-
-        node = server.store.node_by_id(alloc.node_id)
-        addr = (
-            node_attributes(node).get("nomad.advertise.address", "")
-            if node is not None else ""
-        )
-        if not addr or addr == self.addr:
-            raise HTTPError(
-                404, f"allocation {alloc_id} has no reachable node agent"
-            )
+        addr = self._node_agent_addr(alloc_id)
         headers = {"Content-Type": "application/json"}
         if token:
             headers["X-Nomad-Token"] = token
@@ -727,15 +711,10 @@ class HTTPAPIServer:
             except (BrokenPipeError, ConnectionResetError, OSError):
                 pass
 
-    def _forward_client_fs(
-        self, handler, path: str, query: Dict, alloc_id: str, token: str
-    ) -> None:
-        """Server-side forwarding: stream the node agent's response
-        through (fs_endpoint.go forwarding leg)."""
-        import urllib.error
-        import urllib.parse
-        import urllib.request
-
+    def _node_agent_addr(self, alloc_id: str) -> str:
+        """Resolve the HTTP address of the node agent holding an alloc —
+        the shared first leg of every server→client forward (fs/logs,
+        exec, restart/signal; fs_endpoint.go forwarding)."""
         server = self.agent.server
         if server is None:
             raise HTTPError(404, f"allocation {alloc_id} not on this agent")
@@ -753,6 +732,43 @@ class HTTPAPIServer:
             raise HTTPError(
                 404, f"allocation {alloc_id} has no reachable node agent"
             )
+        return addr
+
+    def _forward_client_alloc_op(self, path: str, body, token: str):
+        """Server leg of restart/signal: POST through to the node agent."""
+        import urllib.error
+        import urllib.request
+
+        m = re.match(r"^/v1/client/allocation/([^/]+)/", path)
+        alloc_id = m.group(1) if m else ""
+        addr = self._node_agent_addr(alloc_id)
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["X-Nomad-Token"] = token
+        req = urllib.request.Request(
+            f"{addr}{path}", data=json.dumps(body or {}).encode(),
+            method="POST", headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as exc:
+            try:
+                msg = json.loads(exc.read()).get("error", str(exc))
+            except Exception:  # noqa: BLE001
+                msg = str(exc)
+            raise HTTPError(exc.code, msg)
+
+    def _forward_client_fs(
+        self, handler, path: str, query: Dict, alloc_id: str, token: str
+    ) -> None:
+        """Server-side forwarding: stream the node agent's response
+        through (fs_endpoint.go forwarding leg)."""
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        addr = self._node_agent_addr(alloc_id)
         qs = urllib.parse.urlencode(query)
         req = urllib.request.Request(
             f"{addr}{path}?{qs}",
@@ -800,6 +816,37 @@ class HTTPAPIServer:
         token: str = "", cluster_secret: str = "",
     ) -> Any:
         server = self.agent.server
+        # Alloc lifecycle ops (`alloc restart` / `alloc signal`;
+        # nomad/client_rpc.go forwarding → client Allocations.Restart/
+        # Signal): served by the node agent holding the alloc, forwarded
+        # by servers like the fs/exec surfaces.
+        m = re.match(r"^/v1/client/allocation/([^/]+)/(restart|signal)$",
+                     path)
+        if m and method in ("PUT", "POST"):
+            from ..acl import CAP_ALLOC_LIFECYCLE
+
+            alloc_id, verb = m.group(1), m.group(2)
+            self._authorize_alloc_ns(alloc_id, CAP_ALLOC_LIFECYCLE, token)
+            client = self.agent.client
+            if client is not None and alloc_id in client.allocs:
+                ar = client.allocs[alloc_id]
+                task = (body or {}).get("Task", "")
+                if verb == "restart":
+                    return {"Restarted": ar.restart_tasks(task)}
+                import signal as _signal
+
+                sig = (body or {}).get("Signal", "SIGTERM")
+                try:
+                    signum = (
+                        int(sig) if str(sig).isdigit()
+                        else int(_signal.Signals[str(sig).upper()])
+                    )
+                except KeyError:
+                    raise HTTPError(400, f"unknown signal {sig!r}")
+                out = ar.signal_tasks(signum, task)
+                return {"Signalled": out["signalled"],
+                        "Errors": out["errors"]}
+            return self._forward_client_alloc_op(path, body, token)
         # Client-local surface: served by any agent running a client,
         # including client-only agents with no server to route through.
         if path == "/v1/client/stats" and method == "GET":

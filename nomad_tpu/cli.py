@@ -337,6 +337,32 @@ def _resolve_alloc_id(client: APIClient, prefix: str) -> str:
     return prefix
 
 
+def cmd_alloc_restart(args) -> int:
+    client = _client(args)
+    alloc_id = _resolve_alloc_id(client, args.alloc_id)
+    out = client.restart_allocation(alloc_id, task=args.task)
+    print(f"Restarted tasks: {out.get('Restarted', [])}")
+    return 0
+
+
+def cmd_alloc_signal(args) -> int:
+    client = _client(args)
+    alloc_id = _resolve_alloc_id(client, args.alloc_id)
+    out = client.signal_allocation(
+        alloc_id, signal=args.signal, task=args.task
+    )
+    print(f"Signalled tasks: {out.get('Signalled', [])}")
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    client = _client(args)
+    alloc_id = _resolve_alloc_id(client, args.alloc_id)
+    out = client.stop_allocation(alloc_id)
+    print(f"Alloc stopping; eval {out.get('EvalID', '')}")
+    return 0
+
+
 def cmd_alloc_exec(args) -> int:
     """Run a command inside a task's context (`nomad alloc exec`,
     command/alloc_exec.go; stdin is read upfront when piped)."""
@@ -760,6 +786,18 @@ def build_parser() -> argparse.ArgumentParser:
                        dest="tail_bytes")
     alogs.set_defaults(fn=cmd_alloc_logs)
 
+    arestart = alloc.add_parser("restart")
+    arestart.add_argument("alloc_id")
+    arestart.add_argument("--task", default="")
+    arestart.set_defaults(fn=cmd_alloc_restart)
+    asignal = alloc.add_parser("signal")
+    asignal.add_argument("alloc_id")
+    asignal.add_argument("signal", nargs="?", default="SIGTERM")
+    asignal.add_argument("--task", default="")
+    asignal.set_defaults(fn=cmd_alloc_signal)
+    astop = alloc.add_parser("stop")
+    astop.add_argument("alloc_id")
+    astop.set_defaults(fn=cmd_alloc_stop)
     aexec = alloc.add_parser("exec")
     aexec.add_argument("alloc_id")
     aexec.add_argument("--task", default="")

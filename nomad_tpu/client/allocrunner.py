@@ -579,6 +579,41 @@ class AllocRunner:
         for tr in list(self.runners.values()):
             tr.kill()
 
+    def restart_tasks(self, task: str = "") -> List[str]:
+        """Operator in-place restart (`alloc restart [task]`); returns the
+        task names restarted (tasks without a live process are skipped)."""
+        restarted = []
+        with self._lock:
+            runners = dict(self.runners)
+        for name, tr in runners.items():
+            if task and name != task:
+                continue
+            if tr.dead:
+                continue
+            if tr.restart():
+                restarted.append(name)
+        return restarted
+
+    def signal_tasks(self, sig: int, task: str = "") -> Dict[str, List]:
+        """Operator signal delivery (`alloc signal`): best-effort per
+        task — one task's failure must not abort (or double-deliver on
+        retry) the others'."""
+        signalled: List[str] = []
+        errors: List[str] = []
+        with self._lock:
+            runners = dict(self.runners)
+        for name, tr in runners.items():
+            if task and name != task:
+                continue
+            if tr.dead:
+                continue
+            try:
+                tr.signal(sig)
+                signalled.append(name)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"{name}: {exc}")
+        return {"signalled": signalled, "errors": errors}
+
     def destroy(self) -> None:
         self._destroyed = True
         self.kill()

@@ -232,7 +232,20 @@ class Client:
                 return
             try:
                 self._ttl = self.server.heartbeat_node(self.node.id) or self._ttl
-                self._disconnected_since = None
+                if self._disconnected_since is not None:
+                    # Reconnected: the server demoted us DOWN -> INIT on
+                    # this heartbeat (heartbeat_node) and waits for the
+                    # client to assert readiness (node_endpoint.go:476) —
+                    # without this push the node stays unschedulable.
+                    self._disconnected_since = None
+                    try:
+                        self.server.update_node_status(
+                            self.node.id, NodeStatus.READY.value
+                        )
+                        log.info("reconnected to servers; node ready")
+                    except Exception:  # noqa: BLE001
+                        log.warning("post-reconnect ready push failed",
+                                    exc_info=True)
             except Exception:  # noqa: BLE001
                 if self._disconnected_since is None:
                     self._disconnected_since = time.time()

@@ -89,6 +89,11 @@ class Driver:
     def inspect_task(self, handle: TaskHandle) -> str:
         return "unknown"
 
+    def signal_task(self, handle: TaskHandle, sig: int) -> None:
+        """Deliver a signal to the running task (Driver.SignalTask,
+        plugins/drivers/driver.go)."""
+        raise DriverError(f"{self.name} driver does not support signals")
+
 
 class _MockInstance:
     def __init__(self):
@@ -190,6 +195,9 @@ class MockDriver(Driver):
             return "unknown"
         return "exited" if inst.done.is_set() else "running"
 
+    def signal_task(self, handle: TaskHandle, sig: int) -> None:
+        handle.config.setdefault("signals_received", []).append(int(sig))
+
 
 class RawExecDriver(Driver):
     """Un-isolated subprocess execution (reference: drivers/rawexec/).
@@ -276,6 +284,19 @@ class RawExecDriver(Driver):
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+
+    def signal_task(self, handle: TaskHandle, sig: int) -> None:
+        proc = self._procs.get(handle.id)
+        pid = proc.pid if proc is not None else handle.pid
+        if not pid:
+            raise DriverError("task has no pid")
+        try:
+            os.killpg(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, sig)
+            except OSError as exc:
+                raise DriverError(str(exc)) from exc
 
     def recover_task(self, handle: TaskHandle) -> bool:
         """Re-attach after an agent restart: the task process is no longer
@@ -600,6 +621,14 @@ class ExecDriver(Driver):
             return "running" if out.get("running") else "exited"
         except (DriverError, OSError):
             return "unknown"
+
+    def signal_task(self, handle: TaskHandle, sig: int) -> None:
+        try:
+            self._get_sidecar(handle.config.get("state_dir", "")).call(
+                "signal", id=handle.id, signal=int(sig)
+            )
+        except OSError as exc:
+            raise DriverError(str(exc)) from exc
 
     def shutdown(self) -> None:
         with self._lock:

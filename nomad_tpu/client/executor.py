@@ -195,6 +195,14 @@ class ExecutorServer:
             "recovered": sup.proc is None,
         }
 
+    def op_signal(self, req):
+        with self.lock:
+            sup = self.tasks.get(req["id"])
+        if sup is None or sup.result is not None:
+            return {"error": "unknown or finished task"}
+        _kill_group(sup.pid, int(req.get("signal", signal.SIGTERM)))
+        return {}
+
     def op_stop(self, req):
         with self.lock:
             sup = self.tasks.get(req["id"])

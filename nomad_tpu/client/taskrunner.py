@@ -69,6 +69,9 @@ class TaskRunner:
         self.handle: Optional[TaskHandle] = None
         self._kill = threading.Event()
         self._done = threading.Event()
+        # Set by restart(): the next restart decision relaunches without
+        # consuming a policy attempt.
+        self._manual_restart = False
         self._thread: Optional[threading.Thread] = None
         self._restarts_in_interval: List[float] = []
         self._attached: Optional[TaskHandle] = None
@@ -351,6 +354,10 @@ class TaskRunner:
         """Restart policy (reference: restarts/restarts.go): ``attempts``
         restarts per ``interval``; past that, mode=fail → dead, mode=delay →
         wait out the interval and reset."""
+        if self._manual_restart:
+            # Operator restart: always relaunch, no attempt consumed.
+            self._manual_restart = False
+            return True, 0.0
         policy = self.restart_policy
         if result is not None and result.successful():
             return False, 0.0  # main task completed
@@ -373,6 +380,31 @@ class TaskRunner:
 
     def kill(self) -> None:
         self._kill.set()
+
+    def restart(self) -> bool:
+        """Operator-initiated in-place restart (`alloc restart`,
+        drivers.TaskRestart semantics): stop the running task; the run
+        loop restarts it immediately WITHOUT consuming a restart-policy
+        attempt (a manual restart is not a failure).  Returns False when
+        there is no live process to restart — the flag must not be left
+        armed to give a later genuine crash a free policy bypass."""
+        handle = self.handle
+        if handle is None:
+            return False
+        self._manual_restart = True
+        try:
+            self.driver.stop_task(handle, self.task.kill_timeout)
+        except Exception:  # noqa: BLE001 — task may have just exited;
+            # the in-flight run cycle consumes the flag either way.
+            pass
+        return True
+
+    def signal(self, sig: int) -> None:
+        """Deliver a signal to the live task (`alloc signal`)."""
+        handle = self.handle
+        if handle is None:
+            raise DriverError("task is not running")
+        self.driver.signal_task(handle, sig)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout=timeout)

@@ -1,0 +1,117 @@
+"""alloc restart / alloc signal (Allocations.Restart/Signal RPCs +
+client_rpc.go forwarding; manual restarts do not consume restart-policy
+attempts)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu import mock
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.client import ClientConfig
+from nomad_tpu.server import ServerConfig
+from nomad_tpu.structs.types import AllocClientStatus, RestartPolicy, Task
+
+
+@pytest.fixture
+def agent(tmp_path):
+    a = Agent(AgentConfig(
+        server_config=ServerConfig(
+            num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ),
+        client_config=ClientConfig(data_dir=str(tmp_path / "c")),
+    ))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def _pid_job(marker_dir):
+    """Task writes its pid then sleeps; restart => new pid line."""
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.ephemeral_disk.size_mb = 10
+    # attempts=0: any policy-driven restart would kill the task; a MANUAL
+    # restart must still relaunch it.
+    tg.restart_policy = RestartPolicy(attempts=0, interval=300, delay=0.1)
+    tg.tasks = [Task(
+        name="main", driver="raw_exec",
+        config={"command": "/bin/sh",
+                "args": ["-c", f"echo $$ >> {marker_dir}/pids; sleep 300"]},
+    )]
+    tg.tasks[0].resources.cpu = 20
+    tg.tasks[0].resources.memory_mb = 32
+    return job
+
+
+class TestAllocRestart:
+    def test_manual_restart_relaunches_without_policy_cost(
+        self, agent, tmp_path
+    ):
+        srv = agent.server
+        job = _pid_job(tmp_path)
+        ev = srv.submit_job(job)
+        srv.wait_for_eval(ev.id, timeout=90)
+        assert _wait(lambda: any(
+            a.client_status == AllocClientStatus.RUNNING.value
+            for a in srv.store.allocs_by_job("default", job.id)
+        ), timeout=60)
+        alloc = srv.store.allocs_by_job("default", job.id)[0]
+        pids = tmp_path / "pids"
+        assert _wait(lambda: pids.exists(), timeout=30)
+
+        api = APIClient(agent.rpc_addr)
+        out = api.restart_allocation(alloc.id)
+        assert out["Restarted"] == ["main"]
+        # New task instance: a second pid line appears; alloc stays
+        # running (policy attempts=0 would have killed it otherwise).
+        assert _wait(lambda: len(
+            pids.read_text().strip().splitlines()
+        ) == 2, timeout=30)
+        ar = agent.client.allocs[alloc.id]
+        time.sleep(0.5)
+        assert not ar.terminal
+
+    def test_signal_delivery(self, agent, tmp_path):
+        srv = agent.server
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.ephemeral_disk.size_mb = 10
+        tg.restart_policy = RestartPolicy(attempts=0, interval=300)
+        marker = tmp_path / "got_usr1"
+        tg.tasks = [Task(
+            name="main", driver="raw_exec",
+            config={"command": "/bin/sh",
+                    "args": ["-c",
+                             f"trap 'touch {marker}' USR1; "
+                             "while true; do sleep 0.2; done"]},
+        )]
+        tg.tasks[0].resources.cpu = 20
+        tg.tasks[0].resources.memory_mb = 32
+        ev = srv.submit_job(job)
+        srv.wait_for_eval(ev.id, timeout=90)
+        assert _wait(lambda: any(
+            a.client_status == AllocClientStatus.RUNNING.value
+            for a in srv.store.allocs_by_job("default", job.id)
+        ), timeout=60)
+        alloc = srv.store.allocs_by_job("default", job.id)[0]
+
+        api = APIClient(agent.rpc_addr)
+        time.sleep(0.3)  # let the trap install
+        out = api.signal_allocation(alloc.id, signal="SIGUSR1")
+        assert out["Signalled"] == ["main"]
+        assert _wait(lambda: marker.exists(), timeout=15)
+
+    def test_unknown_alloc_404(self, agent):
+        from nomad_tpu.api.client import APIError
+
+        with pytest.raises(APIError) as exc:
+            APIClient(agent.rpc_addr).restart_allocation("nope")
+        assert exc.value.code == 404

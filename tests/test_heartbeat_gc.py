@@ -190,3 +190,49 @@ class TestHeartbeatStop:
             client.server = srv
             client.shutdown()
             srv.shutdown()
+
+    def test_reconnect_restores_ready(self, tmp_path):
+        """A node marked DOWN by server-side TTL expiry must return to
+        READY service after the partition heals: the server demotes
+        DOWN -> INIT on the first heartbeat back (node_endpoint.go:476)
+        and the CLIENT pushes READY on reconnect."""
+        import time as _time
+
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs.types import NodeStatus
+
+        srv = Server(ServerConfig(
+            num_workers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+        ))
+        srv.start()
+        client = Client(srv, ClientConfig(data_dir=str(tmp_path / "c")))
+        client.start()
+        try:
+            node_id = client.node.id
+
+            class Unreachable:
+                def __getattr__(self, name):
+                    def boom(*a, **kw):
+                        raise ConnectionError("partitioned")
+                    return boom
+
+            real = client.server
+            client.server = Unreachable()
+            # Server-side expiry fires (simulate the wheel's verdict).
+            srv._on_heartbeat_expired(node_id)
+            assert srv.store.node_by_id(
+                node_id
+            ).status == NodeStatus.DOWN.value
+            # Wait until the client has noticed the partition.
+            assert _wait(
+                lambda: client._disconnected_since is not None, timeout=30
+            )
+            # Heal: the client's fast reconnect probe restores READY.
+            client.server = real
+            assert _wait(lambda: srv.store.node_by_id(
+                node_id
+            ).status == NodeStatus.READY.value, timeout=30)
+        finally:
+            client.shutdown()
+            srv.shutdown()

@@ -119,6 +119,18 @@ class TestNativeExecutor:
         assert res.get("recovered") is True
         assert marker.exists()  # kept running across the sidecar's death
 
+    def test_signal_op(self, sidecar, tmp_path):
+        marker = tmp_path / "usr1"
+        self._start(
+            sidecar, tmp_path, "t6",
+            ["/bin/sh", "-c",
+             f"trap 'touch {marker}' USR1; while true; do sleep 0.2; done"],
+        )
+        time.sleep(0.3)
+        sidecar.call("signal", id="t6", signal=signal.SIGUSR1)
+        assert _wait(lambda: marker.exists(), timeout=10)
+        sidecar.call("destroy", id="t6")
+
     def test_rlimits_applied(self, sidecar, tmp_path):
         # RLIMIT_FSIZE 1024: writing >1KB must fail the task (SIGXFSZ).
         self._start(
