@@ -300,6 +300,12 @@ class APIClient:
             "GET", f"/v1/scaling/policies?namespace={namespace}"
         )
 
+    def validate_job(self, job_payload: Dict) -> Dict:
+        return self._call("PUT", "/v1/validate/job", {"Job": job_payload})
+
+    def list_evaluations(self, namespace: str = "default") -> List[Dict]:
+        return self._call("GET", f"/v1/evaluations?namespace={namespace}")
+
     def parse_job_hcl(self, hcl: str) -> Dict:
         return self._call("POST", "/v1/jobs/parse", {"JobHCL": hcl})
 

@@ -281,7 +281,9 @@ class HTTPAPIServer:
             if not acl.allow_operator(want):
                 raise HTTPError(403, f"Permission denied (operator:{want})")
             return
-        if path == "/v1/jobs" or path.startswith("/v1/job"):
+        if path == "/v1/jobs" or path.startswith("/v1/job") or (
+            path == "/v1/validate/job"
+        ):
             # The query namespace gates list/lookups (store keys are
             # (namespace, id), so the queried ns IS the resource's); write
             # bodies that carry their own Namespace are re-checked against
@@ -993,6 +995,30 @@ class HTTPAPIServer:
                 raise HTTPError(400, str(exc))
             return {"EvalID": ev.id if ev else "", "JobModifyIndex":
                     store.job_by_id(job.namespace, job.id).modify_index}
+        if path == "/v1/validate/job" and method in ("PUT", "POST"):
+            # Admission dry run (nomad/job_endpoint.go Validate): mutate +
+            # validate without registering.
+            from ..server.admission import admit
+
+            payload = (body or {}).get("Job", body)
+            if payload is None:
+                raise HTTPError(400, "missing job")
+            try:
+                job = api_to_job(payload)
+                admit(job)
+            except ValueError as exc:
+                return {
+                    "Valid": False,
+                    "ValidationErrors": str(exc).split("; "),
+                }
+            except (TypeError, AttributeError, KeyError) as exc:
+                # Type-malformed payloads (a string where a list belongs)
+                # are invalid input, not server errors.
+                return {
+                    "Valid": False,
+                    "ValidationErrors": [f"malformed job payload: {exc}"],
+                }
+            return {"Valid": True, "ValidationErrors": []}
         if path == "/v1/jobs/parse" and method == "POST":
             hcl = (body or {}).get("JobHCL", "")
             if not hcl:

@@ -458,6 +458,33 @@ def cmd_search(args) -> int:
     return 0
 
 
+def cmd_job_validate(args) -> int:
+    """Server-side admission dry run (`nomad job validate`)."""
+    job = parse_job(open(args.jobfile).read())
+    out = _client(args).validate_job(job_to_api(job))
+    if out["Valid"]:
+        print("Job validation successful")
+        return 0
+    for e in out["ValidationErrors"]:
+        print(f"  - {e}", file=sys.stderr)
+    return 1
+
+
+def cmd_job_inspect(args) -> int:
+    """Full stored job JSON (`nomad job inspect`)."""
+    _print(_client(args).get_job(args.job_id, args.namespace))
+    return 0
+
+
+def cmd_eval_list(args) -> int:
+    for e in _client(args).list_evaluations(namespace=args.namespace):
+        print(
+            f"{e['id'][:8]} {e['job_id']:32} {e['triggered_by']:20} "
+            f"{e['status']}"
+        )
+    return 0
+
+
 def cmd_job_dispatch(args) -> int:
     client = _client(args)
     payload = b""
@@ -693,6 +720,13 @@ def build_parser() -> argparse.ArgumentParser:
     parse = job.add_parser("parse")
     parse.add_argument("jobfile")
     parse.set_defaults(fn=cmd_job_parse)
+    validate = job.add_parser("validate")
+    validate.add_argument("jobfile")
+    validate.set_defaults(fn=cmd_job_validate)
+    inspect = job.add_parser("inspect")
+    inspect.add_argument("job_id")
+    inspect.add_argument("--namespace", default="default")
+    inspect.set_defaults(fn=cmd_job_inspect)
     dispatch = job.add_parser("dispatch")
     dispatch.add_argument("job_id")
     dispatch.add_argument("payload_file", nargs="?", default="")
@@ -847,6 +881,9 @@ def build_parser() -> argparse.ArgumentParser:
     ev = sub.add_parser("eval", help="evaluation ops").add_subparsers(
         dest="eval_cmd", required=True
     )
+    elist = ev.add_parser("list")
+    elist.add_argument("--namespace", default="default")
+    elist.set_defaults(fn=cmd_eval_list)
     estatus = ev.add_parser("status")
     estatus.add_argument("eval_id")
     estatus.set_defaults(fn=cmd_eval_status)

@@ -321,8 +321,13 @@ class Server:
     # ------------------------------------------------------------------
 
     def submit_job(self, job: Job) -> Optional[Evaluation]:
-        # Admission validation (job_endpoint_hooks.go validate): an
-        # exclusive-writer volume cannot back more than one alloc.
+        # Admission pipeline (job_endpoint_hooks.go): mutate
+        # (canonicalize + implied constraints), then validate — rejects
+        # before anything journals.
+        from .admission import admit
+
+        admit(job)
+        # An exclusive-writer volume cannot back more than one alloc.
         for tg in job.task_groups:
             for vreq in (tg.volumes or {}).values():
                 if (

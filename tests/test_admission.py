@@ -1,0 +1,132 @@
+"""Job admission pipeline (nomad/job_endpoint_hooks.go): mutate +
+validate at register time; /v1/validate/job dry run; `job validate`
+CLI."""
+
+from __future__ import annotations
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.admission import admit
+from nomad_tpu.structs.types import (
+    Constraint,
+    ScalingPolicy,
+    Task,
+    TaskGroup,
+    VolumeMount,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(
+        num_workers=0, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+    ))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+class TestAdmit:
+    def test_canonicalizes(self):
+        job = mock.job()
+        job.name = ""
+        job.datacenters = []
+        admit(job)
+        assert job.name == job.id
+        assert job.datacenters == ["dc1"]
+
+    def test_collects_all_errors(self):
+        job = mock.job()
+        job.priority = 500
+        job.type = "weird"
+        tg = job.task_groups[0]
+        tg.count = -1
+        tg.tasks.append(Task(name=tg.tasks[0].name))  # duplicate name
+        with pytest.raises(ValueError) as exc:
+            admit(job)
+        msg = str(exc.value)
+        # Job-level operand errors appear once, not once per group.
+        job2 = mock.job()
+        job2.task_groups.append(mock.job().task_groups[0])
+        job2.task_groups[1].name = "other"
+        job2.constraints = [Constraint(
+            l_target="${attr.x}", r_target="y", operand="~="
+        )]
+        with pytest.raises(ValueError) as exc2:
+            admit(job2)
+        assert str(exc2.value).count("unknown constraint operand") == 1
+        # Task-level operands are validated too.
+        job3 = mock.job()
+        job3.task_groups[0].tasks[0].constraints = [Constraint(
+            l_target="${attr.x}", r_target="y", operand="!!"
+        )]
+        with pytest.raises(ValueError):
+            admit(job3)
+        assert "priority" in msg
+        assert "unknown job type" in msg
+        assert "negative count" in msg
+        assert "duplicate task" in msg
+
+    def test_rejects_bad_operand_and_dangling_mount(self):
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.constraints = [Constraint(
+            l_target="${attr.x}", r_target="y", operand="~="
+        )]
+        tg.tasks[0].volume_mounts = [VolumeMount(volume="ghost")]
+        with pytest.raises(ValueError) as exc:
+            admit(job)
+        assert "operand" in str(exc.value)
+        assert "undeclared volume" in str(exc.value)
+
+    def test_rejects_scaling_min_over_max(self):
+        job = mock.job()
+        job.task_groups[0].scaling = ScalingPolicy(min=5, max=2)
+        with pytest.raises(ValueError):
+            admit(job)
+
+    def test_server_rejects_before_journal(self, server):
+        job = mock.job()
+        job.priority = 0
+        with pytest.raises(ValueError):
+            server.submit_job(job)
+        assert server.store.job_by_id(job.namespace, job.id) is None
+
+
+class TestValidateEndpoint:
+    def test_http_validate_dry_run(self, tmp_path):
+        from nomad_tpu.api import Agent, AgentConfig
+        from nomad_tpu.api.client import APIClient
+        from nomad_tpu.client import ClientConfig
+        from nomad_tpu.jobspec import job_to_api
+
+        a = Agent(AgentConfig(
+            server_config=ServerConfig(
+                num_workers=0, heartbeat_min_ttl=60, heartbeat_max_ttl=90
+            ),
+            client_config=ClientConfig(data_dir=str(tmp_path / "c")),
+        ))
+        a.start()
+        try:
+            api = APIClient(a.rpc_addr)
+            good = mock.job()
+            out = api.validate_job(job_to_api(good))
+            assert out["Valid"] is True
+
+            bad = mock.job()
+            bad.priority = -3
+            out = api.validate_job(job_to_api(bad))
+            assert out["Valid"] is False
+            assert any("priority" in e for e in out["ValidationErrors"])
+            # Type-malformed payloads are invalid input, not 500s.
+            out = api.validate_job({"id": "x", "task_groups": [
+                {"tasks": "oops"}
+            ]})
+            assert out["Valid"] is False
+            assert any("malformed" in e for e in out["ValidationErrors"])
+            # Nothing registered by the dry run.
+            assert api.list_jobs() == []
+        finally:
+            a.shutdown()
