@@ -94,6 +94,11 @@ class Driver:
         plugins/drivers/driver.go)."""
         raise DriverError(f"{self.name} driver does not support signals")
 
+    def stats_task(self, handle: TaskHandle) -> Dict[str, Any]:
+        """Point-in-time resource usage (TaskStats; the reference streams
+        these, plugins/drivers driver.proto).  Empty dict = unsupported."""
+        return {}
+
 
 class _MockInstance:
     def __init__(self):
@@ -297,6 +302,16 @@ class RawExecDriver(Driver):
                 os.kill(pid, sig)
             except OSError as exc:
                 raise DriverError(str(exc)) from exc
+
+    def stats_task(self, handle: TaskHandle) -> Dict[str, Any]:
+        from .executor import _group_usage
+
+        proc = self._procs.get(handle.id)
+        pid = proc.pid if proc is not None else handle.pid
+        if not pid:
+            return {}
+        rss, ticks = _group_usage(pid)
+        return {"rss_bytes": rss, "cpu_ticks": ticks, "pid": pid}
 
     def recover_task(self, handle: TaskHandle) -> bool:
         """Re-attach after an agent restart: the task process is no longer
@@ -629,6 +644,18 @@ class ExecDriver(Driver):
             )
         except OSError as exc:
             raise DriverError(str(exc)) from exc
+
+    def stats_task(self, handle: TaskHandle) -> Dict[str, Any]:
+        try:
+            out = self._get_sidecar(
+                handle.config.get("state_dir", "")
+            ).call("stats", id=handle.id)
+        except (DriverError, OSError):
+            return {}
+        return {
+            k: out[k] for k in ("rss_bytes", "cpu_ticks", "pid")
+            if k in out
+        }
 
     def shutdown(self) -> None:
         with self._lock:

@@ -195,6 +195,24 @@ class ExecutorServer:
             "recovered": sup.proc is None,
         }
 
+    def op_stats(self, req):
+        """Per-task resource usage (TaskStats, plugins/drivers
+        driver.proto TaskStats stream — one-shot poll here): RSS + utime/
+        stime ticks from /proc, summed over the task's process group."""
+        with self.lock:
+            sup = self.tasks.get(req["id"])
+        if sup is None:
+            return {"error": "unknown task"}
+        if sup.result is not None:
+            return {"running": False}
+        rss, ticks = _group_usage(sup.pid)
+        return {
+            "running": True,
+            "rss_bytes": rss,
+            "cpu_ticks": ticks,
+            "pid": sup.pid,
+        }
+
     def op_signal(self, req):
         with self.lock:
             sup = self.tasks.get(req["id"])
@@ -281,6 +299,31 @@ class ExecutorServer:
         self.save_state()
         with Server(sock_path, Handler) as s:
             s.serve_forever()
+
+
+def _group_usage(leader_pid: int):
+    """(rss_bytes, cpu_ticks) summed over the process group led by
+    ``leader_pid`` (setsid makes pgid == leader pid)."""
+    rss = 0
+    ticks = 0
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as fh:
+                    parts = fh.read().rsplit(") ", 1)[-1].split()
+                # after comm: state(0) ppid(1) pgrp(2) ... utime(11)
+                # stime(12) ... rss(21) [indices relative to post-comm]
+                if int(parts[2]) != leader_pid:
+                    continue
+                ticks += int(parts[11]) + int(parts[12])
+                rss += int(parts[21]) * os.sysconf("SC_PAGE_SIZE")
+            except (OSError, ValueError, IndexError):
+                continue
+    except OSError:
+        pass
+    return rss, ticks
 
 
 def _pid_alive(pid: int) -> bool:
