@@ -356,6 +356,37 @@ static const std::map<std::string, int> kRlimits = {
     {"nproc", RLIMIT_NPROC},
 };
 
+// execve() does no PATH search: a bare argv[0] ("python3") is taken as a
+// path relative to the task cwd and exits 127 even when the command is on
+// the task's PATH.  Resolve it against the REQUEST env's PATH (the task's
+// view of the world, which may differ from the supervisor's), falling
+// back to the supervisor's own.
+static std::string resolve_argv0(const std::string& cmd,
+                                 const std::vector<std::string>& envs) {
+  if (cmd.empty() || cmd.find('/') != std::string::npos) return cmd;
+  std::string path;
+  for (auto& e : envs)
+    if (e.rfind("PATH=", 0) == 0) { path = e.substr(5); break; }
+  if (path.empty()) {
+    const char* p = getenv("PATH");
+    path = p ? p : "";
+  }
+  size_t start = 0;
+  while (start <= path.size()) {
+    size_t end = path.find(':', start);
+    std::string dir = end == std::string::npos
+                          ? path.substr(start)
+                          : path.substr(start, end - start);
+    if (!dir.empty()) {
+      std::string cand = dir + "/" + cmd;
+      if (access(cand.c_str(), X_OK) == 0) return cand;
+    }
+    if (end == std::string::npos) break;
+    start = end + 1;
+  }
+  return cmd;
+}
+
 static Json op_start(const Json& req) {
   std::string id = req.s("id");
   {
@@ -380,6 +411,7 @@ static Json op_start(const Json& req) {
   std::vector<std::string> envs;
   for (auto& kv : req.at("env").obj)
     envs.push_back(kv.first + "=" + kv.second.str);
+  argv[0] = resolve_argv0(argv[0], envs);
 
   std::string cgroup;
   if (req.truthy("cgroup")) {

@@ -20,11 +20,12 @@ from __future__ import annotations
 
 import json
 import re
-import time
 import urllib.error
 import urllib.request
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
+from ..chaos import inject
+from ..retry import RetryBudgetExceeded, RetryPolicy, retry_call
 from ..structs import serde
 from ..structs.types import Allocation, Node
 
@@ -49,27 +50,43 @@ class HTTPServerRPC:
     # ------------------------------------------------------------------
 
     def _call(self, path: str, payload=None, timeout=None):
+        # Chaos seam: a request can be lost, erred, delayed (handled inside
+        # inject), or duplicated before it ever reaches the wire.
+        fault = inject("rpc.call", path=path, addr=self.addr)
+        if fault is not None:
+            if fault.kind == "drop":
+                raise RPCError(f"{path}: injected connection drop")
+            if fault.kind == "error":
+                raise RPCError(f"{path}: 500 injected server error")
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["X-Nomad-Token"] = self.token
-        req = urllib.request.Request(
-            self.addr + path,
-            data=data,
-            method="POST" if data is not None else "GET",
-            headers=headers,
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout
-            ) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as exc:
-            raise RPCError(
-                f"{path}: {exc.code} {exc.read().decode(errors='replace')}"
-            ) from exc
-        except urllib.error.URLError as exc:
-            raise RPCError(f"{path}: {exc.reason}") from exc
+
+        def post_once():
+            req = urllib.request.Request(
+                self.addr + path,
+                data=data,
+                method="POST" if data is not None else "GET",
+                headers=headers,
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout
+                ) as resp:
+                    return json.loads(resp.read() or b"null")
+            except urllib.error.HTTPError as exc:
+                raise RPCError(
+                    f"{path}: {exc.code} {exc.read().decode(errors='replace')}"
+                ) from exc
+            except urllib.error.URLError as exc:
+                raise RPCError(f"{path}: {exc.reason}") from exc
+
+        if fault is not None and fault.kind == "dup":
+            # A retransmitted request (lost ack): the server must treat the
+            # second copy idempotently; callers see the second response.
+            post_once()
+        return post_once()
 
     # ------------------------------------------------------------------
     # The five-method client↔server surface
@@ -148,7 +165,13 @@ class FailoverRPC:
     client/servers/manager.go).
     """
 
-    def __init__(self, addrs: List[str], timeout: float = 10.0, token: str = ""):
+    def __init__(
+        self,
+        addrs: List[str],
+        timeout: float = 10.0,
+        token: str = "",
+        retry_policy: "RetryPolicy | None" = None,
+    ):
         assert addrs, "need at least one server address"
         self.token = token
         self.rpcs = {
@@ -156,6 +179,18 @@ class FailoverRPC:
         }
         self.addrs = list(addrs)
         self.current = self.addrs[0]
+        # Failover budget: enough attempts to visit every server twice
+        # (one full rotation may land mid-election), jittered so a fleet
+        # of clients doesn't hammer the new leader in lockstep, with a
+        # hard deadline so a fully-partitioned client surfaces an error
+        # instead of spinning forever.
+        self.retry_policy = retry_policy or RetryPolicy(
+            base_delay=0.05,
+            max_delay=1.0,
+            max_attempts=2 * len(self.addrs),
+            deadline=max(15.0, 2 * len(self.addrs) * timeout),
+            attempt_timeout=timeout,
+        )
 
     def _retarget(self, err: RPCError) -> None:
         hint = _LEADER_HINT.search(str(err))
@@ -166,17 +201,24 @@ class FailoverRPC:
         self.current = self.addrs[(idx + 1) % len(self.addrs)]
 
     def _with_failover(self, fn_name: str, *args, **kwargs):
-        last: Optional[RPCError] = None
-        for _ in range(2 * len(self.addrs)):
-            try:
-                return getattr(self.rpcs[self.current], fn_name)(
-                    *args, **kwargs
-                )
-            except RPCError as exc:
-                last = exc
-                self._retarget(exc)
-                time.sleep(0.05)
-        raise last  # type: ignore[misc]
+        def attempt():
+            return getattr(self.rpcs[self.current], fn_name)(*args, **kwargs)
+
+        def on_retry(n, exc, delay):
+            self._retarget(exc)
+
+        try:
+            return retry_call(
+                attempt,
+                policy=self.retry_policy,
+                retry_on=(RPCError,),
+                on_retry=on_retry,
+                description=f"rpc failover {fn_name}",
+            )
+        except RetryBudgetExceeded as exc:
+            # Callers (and tests) match on RPCError; surface the last
+            # underlying RPC failure, not the budget wrapper.
+            raise exc.__cause__  # type: ignore[misc]
 
     def register_node(self, node: Node) -> float:
         return self._with_failover("register_node", node)

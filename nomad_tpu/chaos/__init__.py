@@ -1,0 +1,26 @@
+"""Deterministic fault injection + cluster invariant checking.
+
+See CHAOS.md for the operator/test-author guide: the seam catalog, fault
+kinds, and how to write and replay a scenario from its seed.
+"""
+
+from .injector import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    FiredFault,
+    active,
+    inject,
+    injected,
+    install,
+    uninstall,
+)
+from .invariants import (  # noqa: F401
+    check_allocs_fit,
+    check_broker,
+    check_cluster,
+    check_convergence,
+    check_replacement_coverage,
+    check_store,
+    check_volume_writers,
+    wait_converged,
+)

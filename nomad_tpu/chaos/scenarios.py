@@ -1,0 +1,385 @@
+"""Seeded chaos scenarios — reusable by tests and ``tools/chaos_repro.py``.
+
+Each scenario is a function ``(seed, workdir, **knobs) -> report dict``:
+
+```
+{"name": ..., "seed": ..., "faults": [(seam, kind, step), ...],
+ "violations": [...], ...extra per-scenario facts}
+```
+
+An empty ``violations`` list means every invariant
+(:mod:`nomad_tpu.chaos.invariants`) held.  Scenarios never assert —
+callers (tests, the repro tool) decide how to react, so a violating run
+can still be inspected.
+
+Replayability: fault *decisions* are a pure function of
+``(seed, seam, hit-number)`` (see injector.py).  Scenarios built from
+``at_step``/``count`` triggers reproduce the identical fired-fault
+schedule run-to-run; probabilistic (``p``) triggers reproduce the same
+decision table, with the fired subset following the seam's actual hit
+count (thread timing can shift how many hits occur before quiescence).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Callable, Dict, List
+
+from .injector import FaultSpec, injected
+from .invariants import check_store, wait_converged
+from .wal_tools import complete_entries_at, sweep
+
+
+def _wait(pred, timeout: float = 30.0, every: float = 0.05) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+def _free_ports(n: int) -> List[int]:
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _http_cluster(n: int = 3):
+    """Spin an n-server HTTP control plane (the test_replication idiom)."""
+    from ..api.agent import Agent, AgentConfig
+    from ..server import ServerConfig
+
+    ports = _free_ports(n)
+    addrs = [f"http://127.0.0.1:{p}" for p in ports]
+    agents = []
+    for i in range(n):
+        agents.append(Agent(AgentConfig(
+            name=f"server-{i}",
+            server_enabled=True,
+            client_enabled=False,
+            http_host="127.0.0.1",
+            http_port=ports[i],
+            server_config=ServerConfig(
+                num_workers=2,
+                heartbeat_min_ttl=60,
+                heartbeat_max_ttl=90,
+                server_id=f"server-{i}",
+                peers=list(addrs),
+                election_timeout=(0.15, 0.3),
+                raft_heartbeat_interval=0.05,
+            ),
+        )))
+    for a in agents:
+        a.start()
+    return agents, addrs
+
+
+def _leader(agents):
+    leaders = [
+        a for a in agents
+        if a.server is not None and a.server.replicator is not None
+        and a.server.replicator.is_leader
+    ]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def _small_job(i: int = 0):
+    from .. import mock
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    for t in tg.tasks:
+        t.resources.cpu = 20 + 5 * (i % 4)
+        t.resources.memory_mb = 32
+        t.config = {"run_for": 0}
+    tg.ephemeral_disk.size_mb = 10
+    return job
+
+
+def _evals_settled(server) -> bool:
+    """Quiescence: nothing pending/checked-out in the broker."""
+    broker = server.eval_broker
+    return broker.pending_count() == 0 and broker.unacked_count() == 0
+
+
+def _fault_rows(inj) -> List[tuple]:
+    return [(f.seam, f.kind, f.step) for f in inj.log]
+
+
+# ----------------------------------------------------------------------
+# Scenario 1: leader killed while plans/entries are in flight
+# ----------------------------------------------------------------------
+
+def leader_kill_mid_apply(seed: int, workdir: str) -> Dict:
+    """Delay the leader's peer streams (widening the mid-replication
+    window), kill the leader while entries are in flight, and require the
+    survivors to elect, finish the work, and converge byte-identically."""
+    from .. import mock
+
+    schedule = [
+        FaultSpec("raft.send", "delay", p=0.4, duration=0.05),
+    ]
+    report: Dict = {"name": "leader_kill_mid_apply", "seed": seed}
+    with injected(seed, schedule) as inj:
+        agents, addrs = _http_cluster(3)
+        try:
+            assert _wait(lambda: _leader(agents) is not None, timeout=20)
+            leader = _leader(agents)
+            for i in range(2):
+                leader.server.register_node(mock.node())
+            evs = [leader.server.submit_job(_small_job(i)) for i in range(3)]
+            # Kill the leader with the tail of those submissions still
+            # streaming to peers (the injected delays hold the window
+            # open) — no drain, no goodbye.
+            leader.shutdown()
+            survivors = [a for a in agents if a is not leader]
+            assert _wait(
+                lambda: _leader(survivors) is not None, timeout=30
+            ), "survivors failed to elect"
+            new_leader = _leader(survivors)
+            # The new leader must still serve writes.
+            post_ev = new_leader.server.submit_job(_small_job(9))
+            assert _wait(
+                lambda: _evals_settled(new_leader.server), timeout=30
+            )
+            report["pre_kill_evals"] = [e.id for e in evs if e is not None]
+            report["post_kill_eval"] = post_ev.id if post_ev else None
+            servers = [a.server for a in survivors]
+            violations = wait_converged(servers, timeout=20)
+            violations += check_store(new_leader.server)
+            report["violations"] = violations
+        finally:
+            for a in agents:
+                try:
+                    a.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+        report["faults"] = _fault_rows(inj)
+    return report
+
+
+# ----------------------------------------------------------------------
+# Scenario 2: WAL truncated at every offset, restore must hold
+# ----------------------------------------------------------------------
+
+def wal_truncation_sweep(
+    seed: int, workdir: str, stride: int = 0
+) -> Dict:
+    """Build real server state, then restore from a copy of its data dir
+    cut at every byte offset (strided).  Every cut must restore without
+    error (torn final record dropped), applied entries must grow
+    monotonically with the offset, and invariants must hold at each cut."""
+    from .. import mock
+    from ..server import Server, ServerConfig
+
+    import shutil
+
+    live_dir = os.path.join(workdir, "wal-live")
+    srv = Server(ServerConfig(
+        num_workers=1, heartbeat_min_ttl=600, heartbeat_max_ttl=900,
+        data_dir=live_dir, snapshot_every=10_000,
+    ))
+    srv.start()
+    try:
+        for _ in range(2):
+            srv.register_node(mock.node())
+        for i in range(3):
+            ev = srv.submit_job(_small_job(i))
+            if ev is not None:
+                srv.wait_for_eval(ev.id, timeout=60)
+        # Capture the CRASH-STOP disk image now: the WAL flushes after
+        # every append, and a clean shutdown would compact the whole log
+        # into a snapshot, leaving no append surface to cut.
+        data_dir = os.path.join(workdir, "wal-src")
+        shutil.copytree(live_dir, data_dir)
+    finally:
+        srv.shutdown()
+
+    # Strides are seeded so different seeds probe different offset
+    # phases; stride=1 (tools/chaos_repro.py --stride 1) is exhaustive.
+    if stride <= 0:
+        stride = 61 + (seed % 13)
+    report: Dict = {
+        "name": "wal_truncation_sweep", "seed": seed, "stride": stride,
+        "faults": [], "cuts": 0,
+    }
+    violations: List[str] = []
+    prev_entries = -1
+    prev_index = -1
+    scratch = os.path.join(workdir, "wal-cuts")
+    for offset, cut_dir in sweep(data_dir, scratch, stride=stride):
+        entries = complete_entries_at(data_dir, offset)
+        try:
+            restored = Server(ServerConfig(
+                num_workers=1, heartbeat_min_ttl=600,
+                heartbeat_max_ttl=900, data_dir=cut_dir,
+            ))
+        except Exception as exc:  # noqa: BLE001
+            violations.append(f"offset {offset}: restore raised {exc!r}")
+            continue
+        report["cuts"] += 1
+        if entries < prev_entries:
+            violations.append(
+                f"offset {offset}: complete entries went backwards"
+            )
+        idx = restored.store.latest_index
+        if entries >= prev_entries and idx < prev_index:
+            violations.append(
+                f"offset {offset}: latest_index regressed "
+                f"{prev_index} -> {idx}"
+            )
+        prev_entries, prev_index = entries, idx
+        for v in check_store(restored):
+            violations.append(f"offset {offset}: {v}")
+        if restored.store.wal is not None:
+            restored.store.wal.close()
+    report["violations"] = violations
+    return report
+
+
+# ----------------------------------------------------------------------
+# Scenario 3: partition a follower, write through it, heal, converge
+# ----------------------------------------------------------------------
+
+def partition_then_heal(seed: int, workdir: str) -> Dict:
+    """Cut the leader→follower link for a deterministic number of sends
+    (count-based: the fired schedule is identical run-to-run), keep
+    writing through the partition, then let the link heal and require all
+    three FSM images to converge."""
+    from .. import mock
+
+    drops = 12 + (seed % 8)
+    report: Dict = {
+        "name": "partition_then_heal", "seed": seed, "drops": drops,
+    }
+    agents, addrs = _http_cluster(3)
+    try:
+        assert _wait(lambda: _leader(agents) is not None, timeout=20)
+        leader = _leader(agents)
+        victim = next(a for a in agents if a is not leader)
+        schedule = [FaultSpec(
+            "raft.send", "drop", match={"dst": victim.rpc_addr},
+            count=drops,
+        )]
+        with injected(seed, schedule) as inj:
+            leader.server.register_node(mock.node())
+            for i in range(3):
+                leader.server.submit_job(_small_job(i))
+            # Hold the partition open until the budgeted drops are spent
+            # (the heal is part of the schedule, not test timing).
+            assert _wait(
+                lambda: sum(
+                    1 for f in inj.log if f.kind == "drop"
+                ) >= drops,
+                timeout=30,
+            ), "partition never exhausted its drop budget"
+            report["faults"] = _fault_rows(inj)
+        assert _wait(lambda: _evals_settled(leader.server), timeout=30)
+        violations = wait_converged(
+            [a.server for a in agents], timeout=20
+        )
+        violations += check_store(leader.server)
+        report["violations"] = violations
+    finally:
+        for a in agents:
+            try:
+                a.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
+# ----------------------------------------------------------------------
+# Scenario 4: drain a node whose driver is wedged
+# ----------------------------------------------------------------------
+
+def wedged_driver_during_drain(seed: int, workdir: str) -> Dict:
+    """Drain a node whose driver swallows stop requests and never reports
+    task exit.  The kill path must time out past the wedge, the drain must
+    complete, and the job must end up whole on the other node."""
+    from .. import mock
+    from ..client import Client, ClientConfig
+    from ..server import Server, ServerConfig
+    from ..structs.types import AllocClientStatus, DrainStrategy
+
+    report: Dict = {"name": "wedged_driver_during_drain", "seed": seed}
+    srv = Server(ServerConfig(
+        num_workers=2, heartbeat_min_ttl=60, heartbeat_max_ttl=90,
+    ))
+    srv.start()
+    clients = []
+    try:
+        for name in ("c1", "c2"):
+            c = Client(srv, ClientConfig(
+                data_dir=os.path.join(workdir, name),
+            ))
+            c.start()
+            clients.append(c)
+        job = _small_job()
+        tg = job.task_groups[0]
+        tg.count = 2
+        for t in tg.tasks:
+            t.config = {}  # run until stopped
+            t.kill_timeout = 0.3
+        ev = srv.submit_job(job)
+        srv.wait_for_eval(ev.id, timeout=60)
+
+        def running():
+            return [
+                a for a in srv.store.allocs_by_job(job.namespace, job.id)
+                if a.client_status == AllocClientStatus.RUNNING.value
+                and not a.terminal_status()
+            ]
+
+        assert _wait(lambda: len(running()) == 2, timeout=30)
+        target = clients[0].node.id
+        schedule = [
+            FaultSpec("driver.stop", "skip"),
+            FaultSpec("driver.wait", "wedge", after_step=1),
+        ]
+        with injected(seed, schedule) as inj:
+            srv.update_node_drain(
+                target,
+                DrainStrategy(
+                    deadline=60.0, force_deadline=time.time() + 60.0
+                ),
+            )
+            srv.drainer.notify()
+            assert _wait(lambda: not [
+                a for a in srv.store.allocs_by_node(target)
+                if not a.terminal_status()
+            ], timeout=60), "drain never finished past the wedged driver"
+            assert _wait(
+                lambda: len(set(a.node_id for a in running())) == 1
+                and len(running()) == 2,
+                timeout=60,
+            ), "job did not recover at full count off the drained node"
+            report["faults"] = _fault_rows(inj)
+        assert _wait(lambda: _evals_settled(srv), timeout=30)
+        report["violations"] = check_store(srv)
+    finally:
+        for c in clients:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        srv.shutdown()
+    return report
+
+
+SCENARIOS: Dict[str, Callable[..., Dict]] = {
+    "leader_kill_mid_apply": leader_kill_mid_apply,
+    "wal_truncation_sweep": wal_truncation_sweep,
+    "partition_then_heal": partition_then_heal,
+    "wedged_driver_during_drain": wedged_driver_during_drain,
+}

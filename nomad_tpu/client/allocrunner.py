@@ -242,10 +242,13 @@ class AllocRunner:
                 size = int(e.get("Size", 0))
                 if budget[0] - size < 0:
                     raise RuntimeError("remote migration size cap exceeded")
-                budget[0] -= size
                 q2 = urllib.parse.urlencode({
                     "path": sub, "limit": str(max(size, 1)),
                 })
+                # Charge the cap against bytes actually READ, not the
+                # origin's self-reported Size — a lying/compromised origin
+                # could otherwise stream unbounded data under a small
+                # advertised size.
                 with _open(
                     f"{addr}/v1/client/fs/cat/{prev_id}?{q2}", timeout=300
                 ) as resp, open(dst, "wb") as out:
@@ -253,6 +256,16 @@ class AllocRunner:
                         chunk = resp.read(1 << 20)
                         if not chunk:
                             break
+                        if budget[0] - len(chunk) < 0:
+                            out.close()
+                            try:
+                                os.unlink(dst)  # drop the partial file
+                            except OSError:
+                                pass
+                            raise RuntimeError(
+                                "remote migration size cap exceeded"
+                            )
+                        budget[0] -= len(chunk)
                         out.write(chunk)
 
         fetched = []

@@ -19,6 +19,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..chaos import inject
+from ..retry import Backoff, RetryPolicy, retry_call
 from ..structs.types import (
     AllocClientStatus,
     AllocDeploymentStatus,
@@ -36,6 +38,18 @@ log = logging.getLogger(__name__)
 
 # Batch window for alloc status updates (client.go:95-97).
 UPDATE_BATCH_WINDOW = 0.2
+
+# Initial registration: servers may still be electing when the agent
+# boots; keep trying with backoff for a full minute before giving up
+# (registerAndHeartbeat's retryIntv discipline, client.go:1550).
+REGISTER_RETRY = RetryPolicy(
+    base_delay=0.2, max_delay=2.0, deadline=60.0
+)
+# Disconnected-probe cadence: fast first probes (reconnection latency),
+# backing off to 2s so a long outage doesn't burn CPU, reset on success.
+DISCONNECT_RETRY = RetryPolicy(base_delay=0.25, max_delay=2.0)
+# Alloc-watch recovery after a failed blocking query.
+WATCH_RETRY = RetryPolicy(base_delay=0.25, max_delay=5.0)
 
 
 class AllocFSError(Exception):
@@ -135,6 +149,11 @@ class Client:
         # When heartbeats began failing, or None while connected
         # (heartbeat-stop policy, client/heartbeatstop.go).
         self._disconnected_since: Optional[float] = None
+        # Last beat the server acknowledged — for client-side gap
+        # detection: beats can be LOST without an error ever surfacing
+        # here (lossy link, wedged thread), in which case the server
+        # expires the node while this loop still believes it is healthy.
+        self._last_beat_ok: Optional[float] = None
 
     # ------------------------------------------------------------------
 
@@ -151,7 +170,16 @@ class Client:
         # copies via serde; the in-process seam must match.
         import copy as _copy
 
-        self._ttl = self.server.register_node(_copy.deepcopy(self.node))
+        self._ttl = retry_call(
+            lambda: self.server.register_node(_copy.deepcopy(self.node)),
+            policy=REGISTER_RETRY,
+            stop=self._shutdown,
+            description="node register",
+        )
+        # Registration armed the server-side TTL: seed the gap detector
+        # so an outage that starts before the FIRST acked beat is still
+        # noticed (missed_window in _heartbeat_loop).
+        self._last_beat_ok = time.time()
         self.node.status = NodeStatus.READY.value
         self.server.update_node_status(self.node.id, NodeStatus.READY.value)
         for target, name in (
@@ -214,12 +242,15 @@ class Client:
     # ------------------------------------------------------------------
 
     def _heartbeat_loop(self) -> None:
+        backoff = Backoff(DISCONNECT_RETRY)
         while not self._shutdown.is_set():
             if self._disconnected_since is not None:
                 # Disconnected: probe fast so reconnection (and the stop
-                # policy below) track real time, not the TTL cadence.
-                wait = 1.0
+                # policy below) track real time, not the TTL cadence —
+                # backing off while the outage persists.
+                wait = backoff.next_delay()
             else:
+                backoff.reset()
                 # Cap the healthy cadence at 10s: the heartbeat doubles as
                 # the disconnect DETECTOR, and stop_after_client_disconnect
                 # windows must not wait out a long TTL before the first
@@ -231,8 +262,27 @@ class Client:
             if self._shutdown.wait(timeout=wait):
                 return
             try:
+                # Chaos seam: a missed beat ("skip") models a lossy link or
+                # a wedged agent thread; "error" models a reachable-but-
+                # failing server.  Delays are absorbed inside inject —
+                # a slow heartbeat that still lands within TTL must be
+                # harmless.
+                fault = inject("client.heartbeat", node=self.node.id)
+                if fault is not None:
+                    if fault.kind == "skip":
+                        continue
+                    if fault.kind == "error":
+                        raise RuntimeError("injected heartbeat failure")
+                # Did the TTL the server promised lapse between acked
+                # beats?  If so the server may have expired us even though
+                # no beat ever FAILED from this side (silently lost beats).
+                missed_window = (
+                    self._last_beat_ok is not None
+                    and time.time() - self._last_beat_ok > self._ttl
+                )
                 self._ttl = self.server.heartbeat_node(self.node.id) or self._ttl
-                if self._disconnected_since is not None:
+                self._last_beat_ok = time.time()
+                if self._disconnected_since is not None or missed_window:
                     # Reconnected: the server demoted us DOWN -> INIT on
                     # this heartbeat (heartbeat_node) and waits for the
                     # client to assert readiness (node_endpoint.go:476) —
@@ -356,6 +406,7 @@ class Client:
         """Blocking-query loop (client.go:1997): wake on allocs-table bumps,
         diff into runAllocs."""
         index = 0
+        backoff = Backoff(WATCH_RETRY)
         while not self._shutdown.is_set():
             try:
                 allocs, index = self.server.get_client_allocs(
@@ -363,8 +414,10 @@ class Client:
                 )
             except Exception:  # noqa: BLE001
                 log.exception("alloc watch failed")
-                time.sleep(1)
+                if self._shutdown.wait(timeout=backoff.next_delay()):
+                    return
                 continue
+            backoff.reset()
             self._run_allocs(allocs)
 
     def _run_allocs(self, server_allocs: List[Allocation]) -> None:

@@ -27,6 +27,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ..chaos import inject
+from ..retry import RetryBudgetExceeded, RetryPolicy, retry_call
 from ..structs.types import Task
 
 
@@ -58,6 +60,22 @@ class TaskHandle:
 
 class DriverError(Exception):
     pass
+
+
+def _chaos(point: str, driver: str, task: str):
+    """Driver-seam chaos hook.  "hang" (a wedged runtime syscall) is
+    absorbed here as a sleep; "error" raises; anything else — "exit127"
+    at start, "wedge" at wait, "skip" at stop — is returned for the
+    caller to act on, since only it can fabricate the right outcome."""
+    fault = inject(point, driver=driver, task=task)
+    if fault is None:
+        return None
+    if fault.kind == "hang":
+        time.sleep(fault.duration or 1.0)
+        return None
+    if fault.kind == "error":
+        raise DriverError(f"injected {point} failure")
+    return fault
 
 
 class Driver:
@@ -127,6 +145,11 @@ class MockDriver(Driver):
 
     def start_task(self, handle: TaskHandle, task: Task, task_dir: str) -> None:
         cfg = task.config or {}
+        fault = _chaos("driver.start", self.name, task.name)
+        if fault is not None and fault.kind == "exit127":
+            # Command-not-found at exec time: starts "successfully", then
+            # the child exits 127 immediately.
+            cfg = dict(cfg, run_for=0, exit_code=127)
         if cfg.get("start_error"):
             raise DriverError(str(cfg["start_error"]))
         block = float(cfg.get("start_block_for", 0))
@@ -158,6 +181,12 @@ class MockDriver(Driver):
         handle.config = dict(cfg)
 
     def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None):
+        fault = _chaos("driver.wait", self.name, handle.task_name)
+        if fault is not None and fault.kind == "wedge":
+            # Wedged driver: never reports the exit, only "still running".
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            return None
         inst = self._instances.get(handle.id)
         if inst is None:
             return ExitResult(err="unknown task")
@@ -166,6 +195,9 @@ class MockDriver(Driver):
         return inst.result
 
     def stop_task(self, handle: TaskHandle, kill_timeout: float) -> None:
+        fault = _chaos("driver.stop", self.name, handle.task_name)
+        if fault is not None and fault.kind == "skip":
+            return  # stop request swallowed by a wedged runtime
         inst = self._instances.get(handle.id)
         if inst is None:
             return
@@ -223,6 +255,9 @@ class RawExecDriver(Driver):
         if not command:
             raise DriverError("raw_exec requires config.command")
         args = [str(command)] + [str(a) for a in cfg.get("args", [])]
+        fault = _chaos("driver.start", self.name, task.name)
+        if fault is not None and fault.kind == "exit127":
+            args = ["/bin/sh", "-c", "exit 127"]  # command-not-found
         stdout = stderr = None
         try:
             stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab")
@@ -251,6 +286,11 @@ class RawExecDriver(Driver):
         handle.started_at = time.time()
 
     def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None):
+        fault = _chaos("driver.wait", self.name, handle.task_name)
+        if fault is not None and fault.kind == "wedge":
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            return None
         proc = self._procs.get(handle.id)
         if proc is None:
             return ExitResult(err="unknown task")
@@ -473,17 +513,19 @@ class SidecarClient:
             stderr=subprocess.DEVNULL,
             start_new_session=True,  # survives the agent
         )
-        deadline = time.time() + 15.0
-        last: Optional[Exception] = None
-        while time.time() < deadline:
-            try:
-                self._call_raw({"op": "ping"})
-                break
-            except (OSError, ValueError) as exc:
-                last = exc
-                time.sleep(0.05)
-        else:
-            raise DriverError(f"executor sidecar failed to start: {last}")
+        try:
+            retry_call(
+                lambda: self._call_raw({"op": "ping"}),
+                policy=RetryPolicy(
+                    base_delay=0.05, max_delay=0.5, deadline=15.0
+                ),
+                retry_on=(OSError, ValueError),
+                description="executor sidecar boot ping",
+            )
+        except RetryBudgetExceeded as exc:
+            raise DriverError(
+                f"executor sidecar failed to start: {exc.__cause__}"
+            ) from exc
         # Recover the orphaned (setsid'd, still-running) tasks by pid.
         for tid, info in orphans.items():
             try:
@@ -553,11 +595,15 @@ class ExecDriver(Driver):
         sidecar.ensure_running()
         env = dict(os.environ)
         env.update({k: str(v) for k, v in (task.env or {}).items()})
+        argv = [str(command)] + [str(a) for a in cfg.get("args", [])]
+        fault = _chaos("driver.start", self.name, task.name)
+        if fault is not None and fault.kind == "exit127":
+            argv = ["/bin/sh", "-c", "exit 127"]  # command-not-found
         try:
             out = sidecar.call(
                 "start",
                 id=handle.id,
-                argv=[str(command)] + [str(a) for a in cfg.get("args", [])],
+                argv=argv,
                 cwd=task_dir,
                 env=env,
                 stdout=os.path.join(task_dir, f"{task.name}.stdout"),
@@ -573,6 +619,11 @@ class ExecDriver(Driver):
         handle.started_at = float(out["start_ts"])
 
     def wait_task(self, handle: TaskHandle, timeout: Optional[float] = None):
+        fault = _chaos("driver.wait", self.name, handle.task_name)
+        if fault is not None and fault.kind == "wedge":
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            return None
         sidecar = self._get_sidecar(handle.config.get("state_dir", ""))
         deadline = None if timeout is None else time.time() + timeout
         while True:

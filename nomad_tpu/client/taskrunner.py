@@ -200,7 +200,15 @@ class TaskRunner:
             if not self._inside_task_dir(target):
                 raise ValueError(f"volume destination {dest!r} escapes task dir")
             os.makedirs(os.path.dirname(target), exist_ok=True)
-            if not os.path.islink(target) and not os.path.exists(target):
+            if read_only:
+                # read_only mount: a symlink would let the task write the
+                # HOST path (symlinks carry no mode, permission bits don't
+                # stop root), so materialize a write-protected snapshot
+                # copy instead — writes can never reach the volume source.
+                # Gap vs a real ro bind mount: later host-side changes
+                # don't propagate into a running task.
+                self._mount_read_only(host_path, target)
+            elif not os.path.islink(target) and not os.path.exists(target):
                 os.symlink(host_path, target)
         if self.task.dispatch_payload and self.dispatch_payload:
             # Dispatch-payload hook (task_runner_hooks.go dispatch →
@@ -219,6 +227,39 @@ class TaskRunner:
             self._fetch_artifact(art)
         for tpl in self.task.templates or []:
             self._render_template(tpl)
+
+    def _mount_read_only(self, host_path: str, target: str) -> None:
+        """Materialize a write-protected snapshot of the volume source.
+        The copy is the enforcement: even a root task scribbling on the
+        mount mutates only the snapshot, never the registered host path.
+        The a-w bits are a best-effort early EACCES for unprivileged
+        tasks."""
+        import shutil
+        import stat
+
+        if os.path.islink(target) or os.path.exists(target):
+            return
+
+        def _strip_w(path: str) -> None:
+            try:
+                mode = os.stat(path).st_mode
+                os.chmod(
+                    path,
+                    mode & ~(stat.S_IWUSR | stat.S_IWGRP | stat.S_IWOTH),
+                )
+            except OSError:
+                pass
+
+        if os.path.isdir(host_path):
+            shutil.copytree(host_path, target, symlinks=True)
+            for root, dirs, files in os.walk(target, topdown=False):
+                for name in files:
+                    _strip_w(os.path.join(root, name))
+                for name in dirs:
+                    _strip_w(os.path.join(root, name))
+        else:
+            shutil.copy2(host_path, target)
+        _strip_w(target)
 
     def _inside_task_dir(self, path: str) -> bool:
         """Sandbox check with a separator suffix — bare startswith would

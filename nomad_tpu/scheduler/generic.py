@@ -208,6 +208,13 @@ class GenericScheduler:
             batch=self.batch,
         )
         stack.set_job(job)
+        replaced = {
+            p.previous_alloc.id for p in places
+            if p.previous_alloc is not None
+        }
+        for stops in ctx.plan.node_update.values():
+            replaced.update(s.id for s in stops)
+        stack.set_replaced(replaced)
         self._stack = stack
 
         # Group placement asks: requests with penalty nodes (reschedules)

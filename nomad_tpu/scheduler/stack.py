@@ -123,9 +123,18 @@ class GenericStack:
         # ClassEligibility / EscapedComputedClass fields).
         self.class_eligibility: Dict[str, bool] = {}
         self.escaped_computed_class = False
+        # Alloc ids this pass is replacing or stopping — the ONLY live
+        # volume claims a new placement may look through (set_replaced).
+        self.replaced_allocs: set = set()
 
     def set_job(self, job: Job) -> None:
         self.job = job
+
+    def set_replaced(self, alloc_ids) -> None:
+        """Declare the allocs this scheduling pass replaces/stops; their
+        volume claims don't block placement (the reconciler releases them
+        in the same plan)."""
+        self.replaced_allocs = set(alloc_ids)
 
     def _record_eligibility(self, class_elig: np.ndarray, host_mask) -> None:
         for key, cid in self.matrix.class_ids.items():
@@ -256,9 +265,10 @@ class GenericStack:
     def _volume_claimable(self, vol, vreq, job: Job) -> bool:
         """Do the volume's live claims admit this request?  Claims from
         terminal (or vanished) allocs don't count — the volume watcher
-        releases them lazily; claims from THIS job's own allocs don't
-        block a replacement placement (the reconciler stops them in the
-        same plan)."""
+        releases them lazily; claims from allocs this pass replaces/stops
+        (set_replaced) don't block their own replacement.  A blanket
+        same-job exemption would let two LIVE allocs of one job
+        double-claim a single-node-writer volume."""
         if vreq.read_only or vol.access_mode == "multi-node-multi-writer":
             return True
         if vol.access_mode != "single-node-writer":
@@ -270,7 +280,7 @@ class GenericStack:
             ) else None
             if a is None or a.terminal_status():
                 continue
-            if a.namespace == job.namespace and a.job_id == job.id:
+            if alloc_id in self.replaced_allocs:
                 continue
             return False
         return True

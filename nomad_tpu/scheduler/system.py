@@ -70,6 +70,12 @@ class SystemScheduler:
 
         stack = SystemStack(ctx, self.matrix)
         stack.set_job(job)
+        # Allocs on tainted nodes are stopped below; only THEIR volume
+        # claims may be looked through when re-placing (see set_replaced).
+        stack.set_replaced({
+            a.id for a in allocs
+            if not a.terminal_status() and a.node_id in tainted
+        })
         self._stack = stack  # eligibility telemetry for blocked-eval keying
 
         live_by_node_tg: Dict[tuple, List[Allocation]] = {}

@@ -341,6 +341,10 @@ class EvalBroker:
         with self._lock:
             return len(self._unack)
 
+    def unacked_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._unack)
+
     def pending_count(self) -> int:
         with self._lock:
             return sum(len(v) for v in self._pending.values())

@@ -21,6 +21,8 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 import time
 
+from ..chaos import inject
+
 
 class HeartbeatManager:
     def __init__(
@@ -66,10 +68,20 @@ class HeartbeatManager:
         """(Re)arm the node's TTL; returns the granted TTL. TTLs are
         jittered to spread thundering herds (heartbeat.go:93)."""
         ttl = self.min_ttl + random.random() * (self.max_ttl - self.min_ttl)
+        # Chaos seam: clock skew.  The server arms a DIFFERENT deadline
+        # than the TTL it grants (duration = skew factor on the armed
+        # side), so a client heartbeating "on time" by its own clock still
+        # expires — the failure mode of drifted hosts.
+        fault = inject("heartbeat.ttl", node=node_id)
+        skew = (
+            fault.duration
+            if fault is not None and fault.kind == "skew" and fault.duration
+            else 1.0
+        )
         with self._lock:
             if not self._enabled:
                 return ttl
-            deadline = time.monotonic() + ttl
+            deadline = time.monotonic() + ttl * skew
             self._deadlines[node_id] = deadline
             wake = not self._heap or deadline < self._heap[0][0]
             heapq.heappush(self._heap, (deadline, node_id))

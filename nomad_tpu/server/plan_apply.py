@@ -318,17 +318,15 @@ class PlanApplier:
                 if vol.access_mode != "single-node-writer":
                     return False  # reader-only volume cannot take a writer
                 key = (a.namespace, vol.id)
-                # Same-job live claims don't block (mirrors the stack's
-                # _volume_claimable): a canary/replacement placement must
-                # not deadlock against the alloc it will replace.
+                # Only the claim held by the alloc THIS placement replaces
+                # (or one stopping in the same plan) is exempt — a blanket
+                # same-job pass would let two live allocs of one job
+                # double-claim a single-node-writer volume.
                 live_foreign = any(
                     (prev := store.allocs.get(aid)) is not None
                     and not prev.terminal_status()
                     and aid not in stopping
-                    and not (
-                        prev.namespace == a.namespace
-                        and prev.job_id == a.job_id
-                    )
+                    and aid != a.previous_allocation
                     for aid in vol.write_claims
                 )
                 if live_foreign or plan_claims.get(key, 0) > 0:

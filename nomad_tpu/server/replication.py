@@ -41,8 +41,11 @@ import time
 import urllib.error
 import urllib.request
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+from ..chaos import inject
+from ..retry import Backoff, RetryPolicy
 
 log = logging.getLogger(__name__)
 
@@ -76,8 +79,23 @@ class PeerState:
     # Failed peers are skipped by the write path until this monotonic
     # time; the heartbeat loop keeps probing and clears it on success, so
     # one dead peer costs writes a single timeout per cooldown window
-    # instead of one per write.
+    # instead of one per write.  The window grows per consecutive failure
+    # through the shared backoff policy (nomad_tpu/retry.py) and snaps
+    # back on the first success.
     retry_after: float = 0.0
+    backoff: Optional[Backoff] = None
+
+    def mark_failed(self, error: str) -> None:
+        self.healthy = False
+        self.last_error = error
+        delay = self.backoff.next_delay() if self.backoff else 0.5
+        self.retry_after = time.monotonic() + delay
+
+    def mark_ok(self) -> None:
+        self.healthy = True
+        self.retry_after = 0.0
+        if self.backoff is not None:
+            self.backoff.reset()
 
 
 class Replicator:
@@ -104,8 +122,16 @@ class Replicator:
         self.server = server
         self.id = server_id
         self.self_addr = self_addr
+        # Per-peer resend cooldown: base = the configured cooldown,
+        # growing exponentially while a peer stays dead so the write path
+        # doesn't pay a probe per window to a long-gone server.
+        self._peer_retry_policy = RetryPolicy(
+            base_delay=peer_cooldown,
+            max_delay=max(peer_cooldown * 8, 2.0),
+            jitter=0.25,
+        )
         self.peers: Dict[str, PeerState] = {
-            a: PeerState(addr=a) for a in peer_addrs if a and a != self_addr
+            a: self._new_peer(a) for a in peer_addrs if a and a != self_addr
         }
         s = TIMEOUT_SCALE
         self.election_timeout = (election_timeout[0] * s,
@@ -113,7 +139,6 @@ class Replicator:
         self.heartbeat_interval = heartbeat_interval * s
         self.rpc_timeout = rpc_timeout
         self.append_timeout = append_timeout
-        self.peer_cooldown = peer_cooldown
         # Shared secret authenticating server↔server raft RPCs (an
         # unauthenticated /v1/internal/raft/snapshot could otherwise replace
         # the whole cluster state).  Sent on every peer RPC; checked by the
@@ -195,7 +220,12 @@ class Replicator:
                     del self.peers[a]
             for a in want:
                 if a not in self.peers:
-                    self.peers[a] = PeerState(addr=a)
+                    self.peers[a] = self._new_peer(a)
+
+    def _new_peer(self, addr: str) -> PeerState:
+        return PeerState(
+            addr=addr, backoff=Backoff(self._peer_retry_policy)
+        )
 
     def ensure_leader(self) -> None:
         if not self.is_leader:
@@ -254,17 +284,31 @@ class Replicator:
         self, addr: str, path: str, payload: Dict,
         timeout: Optional[float] = None,
     ) -> Dict:
+        # Chaos seam: the partition primitive.  Matching on src/dst cuts
+        # specific links (asymmetric partitions included); sustained drops
+        # on the append path starve followers of heartbeats and force
+        # elections.  "dup" replays an entry append (the PrevSeq check on
+        # the receiver must reject the stale duplicate).
+        fault = inject("raft.send", path=path, src=self.id, dst=addr)
+        if fault is not None and fault.kind == "drop":
+            raise urllib.error.URLError("injected partition")
         data = json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"}
         if self.cluster_secret:
             headers["X-Nomad-Cluster-Secret"] = self.cluster_secret
-        req = urllib.request.Request(
-            addr + path, data=data, method="POST", headers=headers,
-        )
-        with urllib.request.urlopen(
-            req, timeout=timeout or self.rpc_timeout
-        ) as resp:
-            return json.loads(resp.read() or b"{}")
+
+        def post_once() -> Dict:
+            req = urllib.request.Request(
+                addr + path, data=data, method="POST", headers=headers,
+            )
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.rpc_timeout
+            ) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        if fault is not None and fault.kind == "dup":
+            post_once()
+        return post_once()
 
     # ------------------------------------------------------------------
     # Leader: entry replication (called from the store's journal hook)
@@ -336,9 +380,7 @@ class Replicator:
                 "Entries": entries,
             }, timeout=self.append_timeout)
         except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
-            peer.healthy = False
-            peer.last_error = str(exc)
-            peer.retry_after = time.monotonic() + self.peer_cooldown
+            peer.mark_failed(str(exc))
             return False
         if out.get("Term", 0) > term:
             self._observe_term(out["Term"])
@@ -372,12 +414,10 @@ class Replicator:
                         )
                     except (urllib.error.URLError, OSError,
                             json.JSONDecodeError) as exc:
-                        peer.healthy = False
-                        peer.last_error = str(exc)
+                        peer.mark_failed(str(exc))
                         return False
                     if out2.get("OK"):
-                        peer.healthy = True
-                        peer.retry_after = 0.0
+                        peer.mark_ok()
                         with self._lock:
                             self.repair_resends += 1
                         log.info("caught %s up by re-send (%d entries)",
@@ -391,9 +431,10 @@ class Replicator:
                 peer.last_error = "needs snapshot catch-up"
                 return False
             return self._install_snapshot(peer, term)
-        peer.healthy = bool(out.get("OK"))
-        if peer.healthy:
-            peer.retry_after = 0.0
+        if out.get("OK"):
+            peer.mark_ok()
+        else:
+            peer.healthy = False
         return peer.healthy
 
     def _install_snapshot(self, peer: PeerState, term: int) -> bool:
@@ -414,13 +455,14 @@ class Replicator:
                 "Snapshot": snap,
             })
             ok = bool(out.get("OK"))
-            peer.healthy = ok
             if ok:
+                peer.mark_ok()
                 log.info("installed snapshot (seq=%d) on %s", seq, peer.addr)
+            else:
+                peer.healthy = False
             return ok
         except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
-            peer.healthy = False
-            peer.last_error = str(exc)
+            peer.mark_failed(str(exc))
             return False
 
     # ------------------------------------------------------------------

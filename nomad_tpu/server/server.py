@@ -938,7 +938,10 @@ class Server:
             if count < 0:
                 raise ValueError("count cannot be negative")
             pol = tg.scaling
-            if pol is not None and pol.enabled:
+            if pol is not None:
+                # Bounds apply even with the policy DISABLED: disabled
+                # stops the autoscaler from acting (scaling.go:74), it
+                # does not lift the operator-declared min/max guardrails.
                 if count < pol.min or (pol.max and count > pol.max):
                     raise ValueError(
                         f"count {count} outside policy bounds "

@@ -1207,6 +1207,24 @@ class StateStore:
                     d2.status = upd.status
                     d2.status_description = upd.status_description
                     self.upsert_deployment(index, d2)
+            # A plan's allocs are copies from the scheduler's snapshot,
+            # which may predate client updates that landed while the eval
+            # was in flight; committing them verbatim rolls client-reported
+            # state back (e.g. a scale-up in-place update clobbering
+            # "running" with the snapshot's "pending").  Keep the store's
+            # client-owned fields (reference: upsertAllocsImpl "keep the
+            # clients task states", nomad/state/state_store.go:3180) unless
+            # the plan asserts "lost" — a server-side verdict that sticks.
+            for alloc in stops + preemptions + allocs:
+                prev = self.allocs.get(alloc.id)
+                if prev is None:
+                    continue
+                alloc.task_states = prev.task_states
+                if alloc.client_status != AllocClientStatus.LOST.value:
+                    alloc.client_status = prev.client_status
+                    alloc.client_description = prev.client_description
+                if alloc.deployment_status is None:
+                    alloc.deployment_status = prev.deployment_status
             self.upsert_allocs(index, stops + preemptions + allocs, now=now)
             # Volume claims for newly placed allocs whose groups request
             # registered volumes (CSIVolumeClaim at plan apply).  Derived

@@ -37,8 +37,16 @@ import json
 import os
 from typing import Any, Iterator, List, Optional, Tuple
 
+from ..chaos import inject
+
 LOG_NAME = "wal.jsonl"
 SNAPSHOT_NAME = "snapshot.json"
+
+
+class WALWriteError(OSError):
+    """An append did not durably complete — the mutation MUST NOT apply
+    (write-ahead contract).  Raised for real I/O failures and injected
+    torn-write/fsync faults alike."""
 
 
 class WriteAheadLog:
@@ -57,6 +65,9 @@ class WriteAheadLog:
         self.snapshot_path = os.path.join(data_dir, SNAPSHOT_NAME)
         self._fh = None
         self.appends_since_snapshot = 0
+        # Set when an injected torn write left a partial tail record;
+        # further appends refuse (see _write) until a reopen/load.
+        self._poisoned = False
         # Per-entry sequence: strictly monotonic across the WAL's lifetime,
         # resumed from the on-disk tail by load().
         self.seq = 0
@@ -130,11 +141,34 @@ class WriteAheadLog:
         self.seq = entry["s"]
 
     def _write(self, entry: dict) -> None:
+        line = json.dumps(entry) + "\n"
         fh = self._open()
-        fh.write(json.dumps(entry) + "\n")
+        # Chaos seam: a crash can tear the record mid-write (a prefix
+        # reaches the platter, no newline) or the disk can fail the fsync
+        # after a complete buffered write.  Both must surface as failed
+        # appends so the write-ahead contract (fail the mutation, never
+        # apply unjournaled state) is exercised end to end.
+        if self._poisoned:
+            # A torn write left a partial record at the tail; appending
+            # after it would corrupt the log MID-file (unrecoverable at
+            # load) instead of at the tail (dropped as a torn final
+            # append).  The owning process must restart and re-load.
+            raise WALWriteError("log poisoned by earlier torn write")
+        fault = inject("wal.write", op=entry.get("op", ""))
+        if fault is not None and fault.kind == "torn":
+            fh.write(line[: max(1, len(line) // 2)])
+            fh.flush()
+            self._poisoned = True
+            raise WALWriteError("injected torn write")
+        fh.write(line)
         fh.flush()
+        if fault is not None and fault.kind == "fsync_error":
+            raise WALWriteError("injected fsync failure")
         if self.fsync:
-            os.fsync(fh.fileno())
+            try:
+                os.fsync(fh.fileno())
+            except OSError as exc:
+                raise WALWriteError(f"fsync failed: {exc}") from exc
         self.appends_since_snapshot += 1
 
     # ------------------------------------------------------------------

@@ -43,6 +43,13 @@ import pytest  # noqa: E402
 
 import nomad_tpu  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: exhaustive chaos sweeps excluded from tier-1 (-m 'not slow')",
+    )
+
 # Kernel first-compiles are tens of seconds; persist them across test runs.
 nomad_tpu.enable_compilation_cache("/root/repo/.jax_cache")
 

@@ -1,0 +1,434 @@
+"""Chaos layer: seeded deterministic fault injection, the shared retry
+policy, the cluster invariant checker, and the four scenario schedules
+from the robustness issue — each replayable from its seed.
+
+Reference analog: the e2e/ + testing-infra tier (Jepsen/FoundationDB-style
+deterministic fault schedules over the real control plane).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from helpers import _wait
+from nomad_tpu import mock
+from nomad_tpu.chaos import (
+    FaultInjector,
+    FaultSpec,
+    active,
+    check_allocs_fit,
+    check_broker,
+    check_replacement_coverage,
+    check_store,
+    check_volume_writers,
+    inject,
+    injected,
+)
+from nomad_tpu.chaos.scenarios import SCENARIOS
+from nomad_tpu.retry import (
+    Backoff,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    retry_call,
+)
+
+
+# ----------------------------------------------------------------------
+# FaultInjector mechanics
+# ----------------------------------------------------------------------
+
+class TestInjector:
+    def test_no_injector_is_a_noop(self):
+        assert active() is None
+        assert inject("rpc.call", path="/x") is None
+
+    def test_scoped_install_uninstall(self):
+        with injected(1, [FaultSpec("a.b", "drop")]) as inj:
+            assert active() is inj
+        assert active() is None
+
+    def test_same_seed_same_decisions(self):
+        """The trigger decision is a pure function of (seed, seam, hit):
+        two injectors with the same seed and schedule produce identical
+        fire logs over the same hit sequence — the replay property."""
+        schedule = lambda: [FaultSpec("raft.send", "drop", p=0.5)]  # noqa: E731
+        logs = []
+        for _ in range(2):
+            inj = FaultInjector(42, schedule())
+            for _ in range(200):
+                inj.fire("raft.send", dst="x")
+            logs.append(list(inj.log))
+        assert logs[0] == logs[1]
+        assert 0 < len(logs[0]) < 200  # p=0.5 actually discriminates
+
+    def test_different_seed_different_decisions(self):
+        def fires(seed):
+            inj = FaultInjector(seed, [FaultSpec("s", "drop", p=0.5)])
+            for _ in range(64):
+                inj.fire("s")
+            return [f.step for f in inj.log]
+
+        assert fires(1) != fires(2)
+
+    def test_at_step_fires_exactly_once(self):
+        inj = FaultInjector(0, [FaultSpec("s", "error", at_step=3)])
+        out = [inj.fire("s") for _ in range(6)]
+        assert [o is not None for o in out] == [
+            False, False, True, False, False, False
+        ]
+
+    def test_count_caps_fires(self):
+        inj = FaultInjector(0, [FaultSpec("s", "drop", count=2)])
+        out = [inj.fire("s") for _ in range(5)]
+        assert sum(o is not None for o in out) == 2
+        assert out[0] is not None and out[1] is not None
+
+    def test_after_step_delays_eligibility(self):
+        inj = FaultInjector(0, [FaultSpec("s", "drop", after_step=2)])
+        out = [inj.fire("s") for _ in range(4)]
+        assert [o is not None for o in out] == [False, False, True, True]
+
+    def test_match_filters_on_ctx(self):
+        inj = FaultInjector(0, [
+            FaultSpec("raft.send", "drop", match={"dst": "b"}),
+        ])
+        assert inj.fire("raft.send", dst="a") is None
+        assert inj.fire("raft.send", dst="b") is not None
+
+    def test_seam_glob(self):
+        inj = FaultInjector(0, [FaultSpec("driver.*", "hang")])
+        assert inj.fire("driver.wait", task="t") is not None
+        assert inj.fire("rpc.call") is None
+
+    def test_delay_absorbed_in_inject(self):
+        with injected(0, [FaultSpec("s", "delay", duration=0.05)]):
+            t0 = time.monotonic()
+            assert inject("s") is None  # absorbed, not returned
+            assert time.monotonic() - t0 >= 0.04
+
+
+# ----------------------------------------------------------------------
+# Shared retry policy
+# ----------------------------------------------------------------------
+
+class TestRetry:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry_call(
+            flaky, RetryPolicy(base_delay=0.001, jitter=0.0),
+            retry_on=(OSError,),
+        )
+        assert out == "ok" and len(calls) == 3
+
+    def test_budget_exceeded_carries_cause(self):
+        def always():
+            raise ValueError("root cause")
+
+        with pytest.raises(RetryBudgetExceeded) as ei:
+            retry_call(
+                always,
+                RetryPolicy(base_delay=0.001, jitter=0.0, max_attempts=3),
+            )
+        assert isinstance(ei.value.__cause__, ValueError)
+
+    def test_non_matching_exception_propagates(self):
+        def boom():
+            raise KeyError("not retryable")
+
+        with pytest.raises(KeyError):
+            retry_call(boom, retry_on=(OSError,))
+
+    def test_stop_event_reraises_original(self):
+        import threading
+
+        stop = threading.Event()
+        stop.set()
+
+        def fail():
+            raise OSError("seen once")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            retry_call(
+                fail, RetryPolicy(base_delay=5.0, jitter=0.0), stop=stop
+            )
+        assert time.monotonic() - t0 < 1.0  # did not serve the backoff
+
+    def test_backoff_growth_cap_reset(self):
+        b = Backoff(RetryPolicy(
+            base_delay=0.1, multiplier=2.0, max_delay=0.4, jitter=0.0
+        ))
+        assert [b.next_delay() for _ in range(4)] == [0.1, 0.2, 0.4, 0.4]
+        b.reset()
+        assert b.next_delay() == 0.1
+
+
+# ----------------------------------------------------------------------
+# Seam behavior (fast, single-component)
+# ----------------------------------------------------------------------
+
+class TestSeams:
+    def test_rpc_drop_and_error(self):
+        from nomad_tpu.api.rpc import HTTPServerRPC, RPCError
+
+        # Both kinds fail the call before any wire I/O, so the dead addr
+        # is never dialed.
+        rpc = HTTPServerRPC("http://127.0.0.1:1", timeout=0.2)
+        with injected(0, [
+            FaultSpec("rpc.call", "drop", at_step=1),
+            FaultSpec("rpc.call", "error", at_step=2),
+        ]):
+            with pytest.raises(RPCError, match="drop"):
+                rpc._call("/v1/internal/ping")
+            with pytest.raises(RPCError, match="injected server error"):
+                rpc._call("/v1/internal/ping")
+
+    def test_wal_torn_write_poisons_then_reload_drops_tail(self, tmp_path):
+        from nomad_tpu.state.wal import WALWriteError, WriteAheadLog
+
+        wal = WriteAheadLog(str(tmp_path))
+        wal.append(1, "upsert_job", {"ok": 1})
+        # (The pre-fault append above ran uninjected, so the torn append
+        # is the injector's hit #1.)
+        with injected(0, [FaultSpec("wal.write", "torn", at_step=1)]):
+            with pytest.raises(WALWriteError, match="torn"):
+                wal.append(2, "upsert_job", {"ok": 2})
+            # Poisoned: appending after a torn tail would corrupt the log
+            # mid-file, so the WAL refuses until reopen — even with no
+            # fault scheduled for this hit.
+            with pytest.raises(WALWriteError, match="poisoned"):
+                wal.append(3, "upsert_job", {"ok": 3})
+        wal.close()
+        snap, entries = WriteAheadLog(str(tmp_path)).load()
+        assert [e["i"] for e in entries] == [1]  # torn record dropped
+
+    def test_heartbeat_skew_arms_shorter_deadline(self):
+        import threading
+
+        from nomad_tpu.server.heartbeat import HeartbeatManager
+
+        expired = threading.Event()
+        hb = HeartbeatManager(
+            on_expire=lambda _nid: expired.set(),
+            min_ttl=2.0, max_ttl=2.0,
+        )
+        hb.set_enabled(True)
+        try:
+            # skew 0.05: the server ARMS a 0.1s deadline while GRANTING
+            # a 2s TTL — the drifted-host failure mode where a client
+            # heartbeating on time by its own clock still expires.
+            with injected(0, [
+                FaultSpec("heartbeat.ttl", "skew", duration=0.05),
+            ]):
+                granted = hb.reset_heartbeat("node-1")
+            assert granted == 2.0  # the client was promised the full TTL
+            assert expired.wait(timeout=1.0), \
+                "skewed deadline never fired (granted TTL not skewed?)"
+        finally:
+            hb.set_enabled(False)
+
+    def test_client_skipped_heartbeats_expire_then_reconnect(self, tmp_path):
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs.types import NodeStatus
+
+        srv = Server(ServerConfig(
+            num_workers=1, heartbeat_min_ttl=0.5, heartbeat_max_ttl=0.6,
+        ))
+        srv.start()
+        client = Client(srv, ClientConfig(data_dir=str(tmp_path / "c")))
+        try:
+            client.start()
+            nid = client.node.id
+
+            def status():
+                n = srv.store.node_by_id(nid)
+                return n.status if n else None
+
+            assert _wait(lambda: status() == NodeStatus.READY.value)
+            # A budget of skipped beats: the server must expire the node,
+            # and once the budget is spent the client's next real beat
+            # must drive DOWN -> INIT -> READY (the reconnect flow).
+            with injected(0, [
+                FaultSpec("client.heartbeat", "skip", count=8),
+            ]):
+                assert _wait(
+                    lambda: status() == NodeStatus.DOWN.value, timeout=20
+                ), "skipped heartbeats never expired the node"
+            assert _wait(
+                lambda: status() == NodeStatus.READY.value, timeout=20
+            ), "node never recovered after the skip budget was spent"
+        finally:
+            client.shutdown()
+            srv.shutdown()
+
+    def test_wal_fsync_error_reports_failure(self, tmp_path):
+        from nomad_tpu.state.wal import WALWriteError, WriteAheadLog
+
+        wal = WriteAheadLog(str(tmp_path))
+        with injected(0, [FaultSpec("wal.write", "fsync_error")]):
+            with pytest.raises(WALWriteError, match="fsync"):
+                wal.append(1, "upsert_job", {})
+        wal.close()
+
+
+# ----------------------------------------------------------------------
+# Invariant checker units (violations built by hand against a raw store)
+# ----------------------------------------------------------------------
+
+class TestInvariants:
+    def _store(self):
+        from nomad_tpu.state.store import StateStore
+
+        return StateStore()
+
+    def test_clean_store_has_no_violations(self):
+        store = self._store()
+        node = mock.node()
+        store.upsert_node(1, node)
+        assert check_replacement_coverage(store) == []
+        assert check_allocs_fit(store) == []
+        assert check_volume_writers(store) == []
+
+    def test_volume_writer_violation_detected(self):
+        from nomad_tpu.structs.types import Volume
+
+        store = self._store()
+        vol = Volume(
+            id="v1", namespace="default",
+            access_mode="single-node-writer",
+        )
+        job = mock.job()
+        a1 = mock.alloc(job)
+        a2 = mock.alloc(job)
+        store.upsert_allocs(1, [a1, a2])
+        vol.write_claims = {a1.id: a1.node_id, a2.id: a2.node_id}
+        with store._lock:
+            store.volumes[(vol.namespace, vol.id)] = vol
+        out = check_volume_writers(store)
+        assert len(out) == 1 and "2 live writers" in out[0]
+
+    def test_overcommit_detected(self):
+        store = self._store()
+        node = mock.node()
+        store.upsert_node(1, node)
+        job = mock.job()
+        allocs = []
+        for _ in range(2):
+            a = mock.alloc(job, node)
+            a.resources.cpu = node.resources.cpu  # each alone fills it
+            allocs.append(a)
+        store.upsert_allocs(2, allocs)
+        out = check_allocs_fit(store)
+        assert len(out) == 1 and "over-committed" in out[0]
+
+    def test_stranded_alloc_detected(self):
+        from nomad_tpu.structs.types import NodeStatus
+
+        store = self._store()
+        node = mock.node()
+        store.upsert_node(1, node)
+        a = mock.alloc(mock.job(), node)
+        store.upsert_allocs(2, [a])
+        node.status = NodeStatus.DOWN.value
+        store.upsert_node(3, node)
+        out = check_replacement_coverage(store)
+        assert len(out) == 1 and "no replacement eval" in out[0]
+
+    def test_broker_flags_stuck_lease_not_transient_checkout(self):
+        class StuckBroker:
+            enabled = True
+
+            def unacked_ids(self):
+                return ["ev-stuck"]
+
+        class TransientBroker:
+            enabled = True
+
+            def __init__(self):
+                self._polls = 0
+
+            def unacked_ids(self):
+                # Worker acks between the first and second sample —
+                # a legitimately busy broker, not a leak.
+                self._polls += 1
+                return ["ev-busy"] if self._polls == 1 else []
+
+        class FakeServer:
+            def __init__(self, broker):
+                self.eval_broker = broker
+
+        out = check_broker(FakeServer(StuckBroker()), settle=0.3)
+        assert out == [
+            "eval broker holds 1 stuck unacked eval(s): ev-stuck"
+        ]
+        assert check_broker(FakeServer(TransientBroker()), settle=0.3) == []
+
+
+# ----------------------------------------------------------------------
+# The four seeded scenarios — the tentpole's acceptance surface
+# ----------------------------------------------------------------------
+
+class TestScenarios:
+    def test_leader_kill_mid_apply(self, tmp_path):
+        report = SCENARIOS["leader_kill_mid_apply"](11, str(tmp_path))
+        assert report["violations"] == [], report
+        # The delay schedule actually widened the window.
+        assert any(k == "delay" for _, k, _ in report["faults"]), report
+
+    def test_wal_truncation_sweep(self, tmp_path):
+        report = SCENARIOS["wal_truncation_sweep"](7, str(tmp_path))
+        assert report["violations"] == [], report
+        assert report["cuts"] > 10
+
+    def test_partition_then_heal(self, tmp_path):
+        report = SCENARIOS["partition_then_heal"](3, str(tmp_path))
+        assert report["violations"] == [], report
+        drops = [f for f in report["faults"] if f[1] == "drop"]
+        assert len(drops) == report["drops"]
+
+    def test_wedged_driver_during_drain(self, tmp_path):
+        report = SCENARIOS["wedged_driver_during_drain"](5, str(tmp_path))
+        assert report["violations"] == [], report
+        kinds = {k for _, k, _ in report["faults"]}
+        assert "skip" in kinds and "wedge" in kinds, report
+
+    def test_partition_schedule_replays_from_seed(self, tmp_path):
+        """Same seed → same drop budget and the same fired-fault schedule
+        (count-triggered: every fired fault is ("raft.send", "drop"), and
+        exactly `drops` of them fire in both runs)."""
+        r1 = SCENARIOS["partition_then_heal"](
+            3, str(tmp_path / "a")
+        )
+        r2 = SCENARIOS["partition_then_heal"](
+            3, str(tmp_path / "b")
+        )
+        assert r1["drops"] == r2["drops"]
+        assert [(s, k) for s, k, _ in r1["faults"]] == \
+            [(s, k) for s, k, _ in r2["faults"]]
+        assert r1["violations"] == r2["violations"] == []
+
+
+@pytest.mark.slow
+class TestExhaustiveSweeps:
+    def test_wal_truncation_every_offset(self, tmp_path):
+        """stride=1: restore from a cut at EVERY byte offset."""
+        report = SCENARIOS["wal_truncation_sweep"](
+            0, str(tmp_path), stride=1
+        )
+        assert report["violations"] == [], report
+
+    @pytest.mark.parametrize("seed", [1, 2, 4, 8])
+    def test_partition_seed_matrix(self, tmp_path, seed):
+        report = SCENARIOS["partition_then_heal"](seed, str(tmp_path))
+        assert report["violations"] == [], report

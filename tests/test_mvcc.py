@@ -100,3 +100,46 @@ def test_history_ring_bounded_degrades_to_live():
     got = snap.node_by_id(node.id)
     # Degraded (documented bound) but never torn or missing.
     assert got is not None
+
+
+def test_plan_apply_preserves_client_reported_status():
+    """A plan's allocs are scheduler-snapshot copies; committing them must
+    not roll back client-reported state that landed mid-eval (scale-up
+    in-place update clobbering "running" back to the snapshot's
+    "pending").  Reference: upsertAllocsImpl keeps the client's task
+    states, nomad/state/state_store.go:3180."""
+    import copy
+
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1, node)
+    a = mock.alloc(n=node, client_status=AllocClientStatus.PENDING.value)
+    store.upsert_allocs(2, [a])
+
+    # Client reports running (Node.UpdateAlloc path) while an eval holds
+    # an older snapshot of the alloc.
+    stale = copy.copy(a)
+    upd = copy.copy(a)
+    upd.client_status = AllocClientStatus.RUNNING.value
+    store.update_allocs_from_client(3, [upd])
+    assert (
+        store.alloc_by_id(a.id).client_status
+        == AllocClientStatus.RUNNING.value
+    )
+
+    # The plan re-upserts the stale copy (in-place update): the store's
+    # client-owned fields must survive.
+    store.upsert_plan_results(4, allocs=[stale], stops=[], preemptions=[])
+    got = store.alloc_by_id(a.id)
+    assert got.client_status == AllocClientStatus.RUNNING.value
+    assert got.modify_index == 4
+
+    # ...but a plan marking the alloc "lost" is a server-side verdict
+    # and must stick.
+    lost = copy.copy(got)
+    lost.client_status = AllocClientStatus.LOST.value
+    store.upsert_plan_results(5, allocs=[], stops=[lost], preemptions=[])
+    assert (
+        store.alloc_by_id(a.id).client_status
+        == AllocClientStatus.LOST.value
+    )

@@ -73,6 +73,32 @@ class TestNativeExecutor:
         assert (tmp_path / "t1.stdout").read_text() == "out-1\n"
         assert (tmp_path / "t1.stderr").read_text() == "err\n"
 
+    def test_bare_command_resolves_against_request_path(
+        self, sidecar, tmp_path
+    ):
+        """execve() does no PATH search: a bare argv[0] used to be taken
+        as cwd-relative and exit 127 even with the command on the task's
+        PATH.  It must resolve against the REQUEST env's PATH."""
+        bindir = tmp_path / "bin"
+        bindir.mkdir()
+        tool = bindir / "hello-tool"
+        tool.write_text("#!/bin/sh\necho resolved-$NATIVE\n")
+        tool.chmod(0o755)
+        out = sidecar.call(
+            "start", id="tp", argv=["hello-tool"],
+            env={"NATIVE": "7", "PATH": f"{bindir}:/usr/bin:/bin"},
+            cwd=str(tmp_path),
+            stdout=str(tmp_path / "tp.stdout"),
+            stderr=str(tmp_path / "tp.stderr"),
+        )
+        assert out["pid"] > 0
+        assert _wait(lambda: not sidecar.call("wait", id="tp").get(
+            "running"
+        ), timeout=15)
+        res = sidecar.call("wait", id="tp")
+        assert res["exit_code"] == 0, res
+        assert (tmp_path / "tp.stdout").read_text() == "resolved-7\n"
+
     def test_start_idempotent(self, sidecar, tmp_path):
         a = self._start(sidecar, tmp_path, "t2", ["/bin/sleep", "30"])
         b = self._start(sidecar, tmp_path, "t2", ["/bin/sleep", "30"])

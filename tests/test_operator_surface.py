@@ -291,6 +291,28 @@ class TestScale:
         assert g["Events"][0]["message"] == "more!"
         assert g["Events"][0]["previous_count"] == 1
 
+    def test_disabled_policy_still_bounds(self, agent, client):
+        """``enabled=False`` stops the autoscaler from ACTING — it does
+        not lift the operator-declared min/max guardrails.  Out-of-bounds
+        scales used to sail through a disabled policy."""
+        srv = agent.server
+        job = _small(mock.job())
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.scaling = ScalingPolicy(min=1, max=3, enabled=False)
+        ev = srv.submit_job(job)
+        srv.wait_for_eval(ev.id, timeout=90)
+
+        with pytest.raises(APIError):
+            client.scale_job(job.id, tg.name, 5)
+        with pytest.raises(APIError):
+            client.scale_job(job.id, tg.name, 0)
+        # In-bounds scaling still works with the policy disabled.
+        out = client.scale_job(job.id, tg.name, 2)
+        assert out["EvalID"]
+        cur = srv.store.job_by_id(job.namespace, job.id)
+        assert cur.task_groups[0].count == 2
+
     def test_unknown_group_rejected(self, agent, client):
         srv = agent.server
         job = _small(mock.job())

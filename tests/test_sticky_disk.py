@@ -214,3 +214,70 @@ def test_cross_node_migration_via_fs_api(tmp_path):
                 a.shutdown()
             except Exception:  # noqa: BLE001
                 pass
+
+
+def test_migration_cap_charged_against_bytes_read(tmp_path):
+    """ADVICE r5: the migration byte cap must be charged against bytes
+    actually READ — an origin that under-reports Size (or ignores the
+    limit param) cannot stream past REMOTE_MIGRATE_CAP and fill this
+    node's disk."""
+    import http.server
+    import json
+    import threading
+
+    from nomad_tpu.client.allocrunner import AllocRunner
+    from nomad_tpu.client.driver import DriverRegistry
+
+    cap = 64 * 1024
+
+    class LyingOrigin(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if "/fs/ls/" in self.path:
+                body = json.dumps([
+                    {"Name": "state.bin", "IsDir": False, "Size": 10},
+                ]).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            # cat: advertise 10 bytes above, stream 64x the cap.
+            total = cap * 64
+            self.send_response(200)
+            self.send_header("Content-Length", str(total))
+            self.end_headers()
+            block = b"\0" * 65536
+            try:
+                for _ in range(total // len(block)):
+                    self.wfile.write(block)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # the capped client hung up — expected
+
+        def log_message(self, *args):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), LyingOrigin)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    addr = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        job = mock.job()
+        tg = job.task_groups[0]
+        alloc = mock.alloc(job)
+        alloc.previous_allocation = "prev0000"
+        ar = AllocRunner(
+            alloc, DriverRegistry(), str(tmp_path / "data"),
+            on_alloc_update=lambda _ar: None,
+            alloc_fs_origin=lambda _pid: {"Addr": addr, "Terminal": True},
+        )
+        ar.REMOTE_MIGRATE_CAP = cap
+        os.makedirs(ar.alloc_dir, exist_ok=True)
+        ar._migrate_remote_disk(tg)
+        # The transfer aborted at the cap and the partial file was
+        # dropped — nothing oversized reached disk.
+        for root, _dirs, files in os.walk(ar.alloc_dir):
+            for f in files:
+                path = os.path.join(root, f)
+                assert os.path.getsize(path) <= cap, path
+            assert "state.bin" not in files
+    finally:
+        httpd.shutdown()

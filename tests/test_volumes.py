@@ -205,6 +205,59 @@ class TestExclusiveSerialization:
         finally:
             client.shutdown()
 
+    def test_exemption_narrowed_to_replaced_alloc(self, server, tmp_path):
+        """The same-job exemption in volume feasibility is now only for
+        the alloc a placement REPLACES.  Registered-after-submission
+        ordering, then a destructive update: the replacement must look
+        through its predecessor's claim (no deadlock), and the writer
+        count must never exceed one."""
+        from nomad_tpu.chaos import check_volume_writers
+
+        client = _client(
+            server, tmp_path, "c1", host_volumes={"disk1": str(tmp_path)}
+        )
+        try:
+            job = _vol_job("late-vol")
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            # Volume doesn't exist yet: nothing places.
+            assert not server.store.allocs_by_job("default", job.id)
+
+            server.store.upsert_volume(
+                server.next_index(),
+                Volume(id="late-vol", source="disk1"),
+            )
+            ev = server.submit_job(job)  # re-eval now the volume exists
+            server.wait_for_eval(ev.id, timeout=90)
+
+            def live():
+                return [
+                    a for a in server.store.allocs_by_job(
+                        "default", job.id
+                    ) if not a.terminal_status()
+                ]
+
+            assert _wait(lambda: len(live()) == 1, timeout=60)
+            first = live()[0]
+            assert _wait(lambda: len(server.store.volume_by_id(
+                "default", "late-vol"
+            ).write_claims) == 1, timeout=30)
+
+            # Destructive update: the replacement placement must not be
+            # blocked by the claim of the very alloc it replaces.
+            updated = job.copy()
+            updated.task_groups[0].tasks[0].env = {"V": "2"}
+            ev = server.submit_job(updated)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(
+                lambda: live() and all(a.id != first.id for a in live()),
+                timeout=60,
+            ), "replacement never placed past its predecessor's claim"
+            assert len(live()) == 1
+            assert check_volume_writers(server.store) == []
+        finally:
+            client.shutdown()
+
     def test_readers_share(self, server, tmp_path):
         client = _client(
             server, tmp_path, "c1", host_volumes={"disk1": str(tmp_path)}
@@ -271,6 +324,54 @@ class TestMountPlumbing:
             assert os.path.islink(link)
             with open(os.path.join(link, "hello.txt")) as fh:
                 assert fh.read() == "from the volume"
+        finally:
+            client.shutdown()
+
+
+    def test_read_only_mount_cannot_write_host_path(
+        self, server, tmp_path
+    ):
+        """A read_only claimant used to get the same writable symlink as
+        a writer.  It must get a write-protected snapshot instead: even a
+        privileged task scribbling on the mount never reaches the
+        registered host path."""
+        import stat
+
+        host_dir = tmp_path / "exported-ro"
+        host_dir.mkdir()
+        (host_dir / "data.txt").write_text("pristine")
+        client = _client(
+            server, tmp_path, "c1",
+            host_volumes={"diskro": str(host_dir)},
+        )
+        try:
+            server.store.upsert_volume(
+                server.next_index(), Volume(id="volro", source="diskro")
+            )
+            job = _vol_job("volro", read_only=True, mount=True)
+            ev = server.submit_job(job)
+            server.wait_for_eval(ev.id, timeout=90)
+            assert _wait(lambda: any(
+                a.client_status == AllocClientStatus.RUNNING.value
+                for a in server.store.allocs_by_job("default", job.id)
+            ), timeout=60)
+            alloc = server.store.allocs_by_job("default", job.id)[0]
+            ar = client.allocs[alloc.id]
+            mnt = os.path.join(
+                ar.alloc_dir, job.task_groups[0].tasks[0].name, "data"
+            )
+            inner = os.path.join(mnt, "data.txt")
+            # Not a symlink into the host path — a snapshot copy.
+            assert not os.path.islink(mnt)
+            with open(inner) as fh:
+                assert fh.read() == "pristine"
+            # Write bits stripped (early EACCES for unprivileged tasks).
+            assert not os.stat(inner).st_mode & stat.S_IWUSR
+            # Even forcing a write onto the mount leaves the host intact.
+            os.chmod(inner, 0o644)
+            with open(inner, "w") as fh:
+                fh.write("scribble")
+            assert (host_dir / "data.txt").read_text() == "pristine"
         finally:
             client.shutdown()
 

@@ -1,0 +1,84 @@
+"""Replay one chaos scenario by name + seed, for debugging a failure.
+
+A failing ``tests/test_chaos.py`` scenario prints its report (name, seed,
+fired faults, violations).  This tool re-runs that exact schedule outside
+pytest so it can be iterated on quickly, with the full report dumped as
+JSON — including the fired-fault rows, which ARE the schedule to compare
+across replays.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_repro.py <scenario> <seed>
+        [--stride N] [--workdir DIR]
+
+    python tools/chaos_repro.py --list
+    python tools/chaos_repro.py wal_truncation_sweep 7 --stride 1
+    python tools/chaos_repro.py partition_then_heal 3
+
+Exit status: 0 when every invariant held, 1 on violations (the report is
+printed either way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("NOMAD_TPU_RAFT_TIMEOUT_SCALE", "2.0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from nomad_tpu.chaos.scenarios import SCENARIOS
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("scenario", nargs="?", help="scenario name")
+    ap.add_argument("seed", nargs="?", type=int, help="schedule seed")
+    ap.add_argument(
+        "--stride", type=int, default=0,
+        help="WAL sweep cut stride (1 = every byte offset; "
+             "0 = the seeded tier-1 stride)",
+    )
+    ap.add_argument(
+        "--workdir", default="",
+        help="scratch dir (default: a fresh temp dir)",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list or not args.scenario:
+        for name in sorted(SCENARIOS):
+            print(name)
+        return 0
+    if args.scenario not in SCENARIOS:
+        ap.error(
+            f"unknown scenario {args.scenario!r} "
+            f"(choices: {', '.join(sorted(SCENARIOS))})"
+        )
+    if args.seed is None:
+        ap.error("seed is required")
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos-repro-")
+    kwargs = {}
+    if args.scenario == "wal_truncation_sweep" and args.stride:
+        kwargs["stride"] = args.stride
+    report = SCENARIOS[args.scenario](args.seed, workdir, **kwargs)
+    print(json.dumps(report, indent=2, default=str))
+    if report.get("violations"):
+        print(
+            f"\n{len(report['violations'])} invariant violation(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("\nall invariants held", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
