@@ -45,6 +45,8 @@ BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
 DISPATCHES = int(os.environ.get("BENCH_DISPATCHES", "100"))
 # In-flight dispatch depth for the pipelined (headline) throughput phase.
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE", "8"))
+# Interactive-batch phase size (user-facing eval burst, LATENCY.md row).
+INTERACTIVE_BATCH = int(os.environ.get("BENCH_INTERACTIVE_BATCH", "256"))
 JOB_SHAPES = 8
 
 # End-to-end loop knobs.  Worker count is the in-flight eval bound: with
@@ -55,6 +57,12 @@ E2E_JOBS = int(os.environ.get("BENCH_E2E_JOBS", "512"))
 E2E_GROUP_COUNT = int(os.environ.get("BENCH_E2E_COUNT", "2"))
 E2E_PROBES = int(os.environ.get("BENCH_E2E_PROBES", "50"))
 E2E_WORKERS = int(os.environ.get("BENCH_E2E_WORKERS", "32"))
+
+# Host-only phase knobs (fake-device e2e burst; see bench_host_only).
+HOST_ONLY = os.environ.get("BENCH_HOST_ONLY", "1") != "0"
+HOST_ONLY_NODES = int(os.environ.get("BENCH_HOST_NODES", "2000"))
+HOST_ONLY_JOBS = int(os.environ.get("BENCH_HOST_JOBS", "1024"))
+HOST_ONLY_WORKERS = int(os.environ.get("BENCH_HOST_WORKERS", "8"))
 
 
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
@@ -160,9 +168,81 @@ def init_backend() -> str:
     raise RuntimeError(f"jax backend init failed permanently: {last}")
 
 
+# Encoded-matrix disk cache: the sim cluster is a pure function of
+# (N_NODES, CAPACITY, N_ALLOCS, seed) — cache the encoded arrays so repeat
+# runs (and TPU retry loops, where every extra setup second widens the
+# mid-run tunnel-wedge window) start measuring in seconds.  Bump the
+# version when the encoding layout changes.
+_CLUSTER_CACHE_VERSION = 1
+
+
+def _cluster_cache_path() -> str:
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(
+        repo, ".bench_cache",
+        f"cluster_v{_CLUSTER_CACHE_VERSION}"
+        f"_{N_NODES}_{CAPACITY}_{N_ALLOCS}.pkl",
+    )
+
+
+def _load_cluster_cache():
+    import pickle
+
+    from nomad_tpu.state.matrix import NodeMatrix
+
+    path = _cluster_cache_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as fh:
+            state = pickle.load(fh)
+    except Exception as e:  # noqa: BLE001 — stale/corrupt cache: rebuild
+        sys.stderr.write(f"bench: cluster cache unreadable ({e}); rebuild\n")
+        return None
+    m = NodeMatrix(capacity=state["capacity"])
+    m.attrs.slot_of = state["attr_slots"]
+    m.devices.slot_of = state["dev_slots"]
+    m.row_of = state["row_of"]
+    m.node_of = state["node_of"]
+    m._free = state["free"]
+    m._next_row = state["next_row"]
+    m.class_ids = state["class_ids"]
+    m.class_repr = state["class_repr"]
+    m._alloc = state["alloc"]
+    m._dirty.update(m.row_of.values())
+    return m
+
+
+def _save_cluster_cache(m) -> None:
+    import pickle
+
+    path = _cluster_cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    state = {
+        "capacity": m.capacity,
+        "attr_slots": m.attrs.slot_of,
+        "dev_slots": m.devices.slot_of,
+        "row_of": m.row_of,
+        "node_of": m.node_of,
+        "free": m._free,
+        "next_row": m._next_row,
+        "class_ids": m.class_ids,
+        "class_repr": m.class_repr,
+        "alloc": m._alloc,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(state, fh, protocol=4)
+    os.replace(tmp, path)
+
+
 def build_cluster():
     from nomad_tpu import mock
     from nomad_tpu.state.matrix import NodeMatrix, PRIORITY_BUCKETS
+
+    cached = _load_cluster_cache()
+    if cached is not None:
+        return cached
 
     rng = np.random.default_rng(42)
     m = NodeMatrix(capacity=CAPACITY)
@@ -191,6 +271,7 @@ def build_cluster():
     for j, b in enumerate(rng.choice(PRIORITY_BUCKETS, 4, replace=False)):
         host["prio_used"][:N_NODES, b] = usage * shares[:, j : j + 1]
     m._dirty.update(range(N_NODES))
+    _save_cluster_cache(m)
     return m
 
 
@@ -286,6 +367,13 @@ def bench_kernel(result: dict) -> None:
     for _ in range(2):
         np.asarray(dispatch().rows)
 
+    # Setup ends here: everything after this line is measurement.
+    # (``setup_s`` used to be stamped at process exit, i.e. it reported the
+    # WHOLE run — the r05 artifact's 103 s — which made "how long until the
+    # bench starts measuring" unreadable from the JSON.)
+    if "_t_setup" in result:
+        result["setup_s"] = round(time.time() - result.pop("_t_setup"), 1)
+
     # Sync latency phase.
     _mark("sync latency phase")
     times = []
@@ -296,9 +384,54 @@ def bench_kernel(result: dict) -> None:
     arr = np.array(times)
     sync_rate = DISPATCHES * BATCH / float(arr.sum())
 
+    # Interactive-batch phase: B=256 (one coalesced burst of user-facing
+    # evals, vs the 4096-deep bulk batch) — the measured version of
+    # LATENCY.md's extrapolated interactive dispatch time.  Each sample is
+    # a full dispatch + device→host fetch; the net-of-RTT column is the
+    # device-side time the 5 ms target judges.
+    _mark("interactive B=256 phase")
+    inp_i = build_batch_inputs(
+        m, [shapes[i % JOB_SHAPES] for i in range(INTERACTIVE_BATCH)]
+    )
+
+    def dispatch_interactive():
+        return score_batch(
+            arrays, arrays.used, inp_i["tg_counts"], inp_i["spread_counts"],
+            inp_i["penalties"], inp_i["reqs"], inp_i["class_eligs"],
+            inp_i["host_masks"],
+        )
+
+    np.asarray(dispatch_interactive().rows)  # compile for the small shape
+    it = []
+    for _ in range(DISPATCHES):
+        t = time.time()
+        np.asarray(dispatch_interactive().rows)
+        it.append(time.time() - t)
+    iarr = np.array(it)
+    result.update(
+        interactive_batch=INTERACTIVE_BATCH,
+        interactive_dispatch_p50_ms=round(
+            float(np.percentile(iarr, 50) * 1000.0), 3
+        ),
+        interactive_dispatch_p99_ms=round(
+            float(np.percentile(iarr, 99) * 1000.0), 3
+        ),
+        interactive_p99_net_of_rtt_ms=round(
+            float(np.percentile(iarr, 99) * 1000.0)
+            - result["rtt_floor_ms"],
+            3,
+        ),
+    )
+
     # Pipelined throughput phase (the headline number).
     _mark(f"pipelined phase (sync rate {sync_rate:.0f}/s)")
     n_pipe = max(DISPATCHES, PIPELINE_DEPTH * 4)
+    if result.get("platform") == "cpu":
+        # CPU fallback: each 10K-node dispatch costs ~1s of host compute;
+        # halve the pipelined sample count to keep the diagnostic run
+        # bounded (the platform is disclosed, the numbers are not the
+        # headline claim).
+        n_pipe = max(DISPATCHES, PIPELINE_DEPTH * 2)
     t0 = time.time()
     inflight = []
     for _ in range(n_pipe):
@@ -457,6 +590,91 @@ def _run_e2e(srv, result: dict) -> None:
         )
 
 
+def bench_host_only(result: dict) -> None:
+    """The e2e burst under the fake-device backend (NOMAD_TPU_FAKE_DEVICE=1):
+    every kernel answer comes from the instant numpy twins, so the number
+    isolates HOST orchestration cost — broker, snapshot-sync, reconcile,
+    encode, plan submit/apply — from device dispatch entirely.
+
+    Runs at HOST_ONLY_NODES (default 2000): the numpy twin executes the
+    device's O(N) scoring serially on the host, so at 10K nodes the twin —
+    a stand-in for work the TPU does in parallel — dominates the wall clock
+    and masks the host-path cost this phase exists to measure.  The scale
+    is disclosed in the output keys."""
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    prev = os.environ.get("NOMAD_TPU_FAKE_DEVICE")
+    os.environ["NOMAD_TPU_FAKE_DEVICE"] = "1"
+    srv = None
+    try:
+        from nomad_tpu import mock
+
+        srv = Server(ServerConfig(
+            num_workers=HOST_ONLY_WORKERS,
+            node_capacity=max(256, 1 << (HOST_ONLY_NODES - 1).bit_length()),
+            heartbeat_min_ttl=3600.0,
+            heartbeat_max_ttl=7200.0,
+        ))
+        srv.start()
+        rng = np.random.default_rng(7)
+        for i in range(HOST_ONLY_NODES):
+            node = mock.node()
+            node.node_class = f"class-{i % 6}"
+            srv.register_node(node)
+        with srv.matrix._host_lock:
+            host = srv.matrix.snapshot_host()
+            host["used"][:HOST_ONLY_NODES] = (
+                rng.uniform(0.1, 0.6, (HOST_ONLY_NODES, 3))
+                * host["totals"][:HOST_ONLY_NODES]
+            )
+            srv.matrix._dirty.update(range(HOST_ONLY_NODES))
+
+        def make_job(i: int):
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = E2E_GROUP_COUNT
+            tg.tasks[0].resources.cpu = 50 + 25 * (i % 4)
+            tg.tasks[0].resources.memory_mb = 64 + 32 * (i % 3)
+            return job
+
+        ev = srv.submit_job(make_job(0))
+        srv.wait_for_eval(ev.id, timeout=120.0)
+
+        t0 = time.time()
+        evals = [srv.submit_job(make_job(i)) for i in range(HOST_ONLY_JOBS)]
+        pending = {e.id for e in evals}
+        deadline = time.time() + 120.0
+        last_index = 0
+        while pending and time.time() < deadline:
+            pending = {
+                eid for eid in pending
+                if not (
+                    (e := srv.store.eval_by_id(eid)) is not None
+                    and e.terminal_status()
+                )
+            }
+            if not pending:
+                break
+            last_index = srv.store.wait_for_table(
+                "evals", last_index, timeout=0.25
+            )
+        wall = time.time() - t0
+        completed = HOST_ONLY_JOBS - len(pending)
+        result.update(
+            e2e_host_only_evals_per_sec=round(completed / wall, 1),
+            e2e_host_only_jobs=HOST_ONLY_JOBS,
+            e2e_host_only_nodes=HOST_ONLY_NODES,
+            e2e_host_only_workers=HOST_ONLY_WORKERS,
+        )
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_FAKE_DEVICE", None)
+        else:
+            os.environ["NOMAD_TPU_FAKE_DEVICE"] = prev
+
+
 def main() -> None:
     t_setup = time.time()
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -475,7 +693,7 @@ def main() -> None:
     if platform == "cpu" and "BENCH_E2E_JOBS" not in os.environ:
         E2E_JOBS = 64
     if platform == "cpu" and "BENCH_E2E_PROBES" not in os.environ:
-        E2E_PROBES = 20
+        E2E_PROBES = 10
 
     result = {
         "metric": "eval_throughput",
@@ -489,7 +707,9 @@ def main() -> None:
     )
     if probe_log:
         result["probe_attempts"] = probe_log
+    result["_t_setup"] = t_setup  # consumed (and removed) by bench_kernel
     bench_kernel(result)
+    result.pop("_t_setup", None)
     if E2E:
         try:
             bench_e2e(result)
@@ -498,7 +718,15 @@ def main() -> None:
 
             traceback.print_exc()
             result["e2e_error"] = f"{type(e).__name__}: {e}"
-    result["setup_s"] = round(time.time() - t_setup, 1)
+    if HOST_ONLY:
+        try:
+            bench_host_only(result)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            result["e2e_host_only_error"] = f"{type(e).__name__}: {e}"
+    result["total_s"] = round(time.time() - t_setup, 1)
     print(json.dumps(result))
 
 

@@ -3,11 +3,11 @@ mock.Node(), mock.Job(), mock.Alloc(), mock.SystemJob(), mock.Eval())."""
 
 from __future__ import annotations
 
-import uuid
 from typing import Optional
 
 from .structs.types import (
     AllocClientStatus,
+    generate_uuid,
     AllocDesiredStatus,
     Allocation,
     DriverInfo,
@@ -46,7 +46,7 @@ def node(**overrides) -> Node:
 
 def job(**overrides) -> Job:
     j = Job(
-        id=f"mock-service-{uuid.uuid4().hex[:8]}",
+        id=f"mock-service-{generate_uuid()[:8]}",
         name="my-job",
         type=JobType.SERVICE.value,
         priority=50,
@@ -73,13 +73,13 @@ def job(**overrides) -> Job:
 def batch_job(**overrides) -> Job:
     j = job(**overrides)
     j.type = JobType.BATCH.value
-    j.id = f"mock-batch-{uuid.uuid4().hex[:8]}"
+    j.id = f"mock-batch-{generate_uuid()[:8]}"
     return j
 
 
 def system_job(**overrides) -> Job:
     j = Job(
-        id=f"mock-system-{uuid.uuid4().hex[:8]}",
+        id=f"mock-system-{generate_uuid()[:8]}",
         name="my-system-job",
         type=JobType.SYSTEM.value,
         priority=100,

@@ -147,6 +147,13 @@ class CompiledTaskGroup:
     # volume table's claims (stack._host_mask; HostVolumeChecker /
     # CSIVolumeChecker, feasible.go:132,209).
     csi_volumes: List["VolumeRequest"] = field(default_factory=list)
+    # Every attr/device slot resolution this compilation made, including
+    # failed ones (None = registry exhausted at compile time).  A cache hit
+    # is valid iff each resolution still holds — so entries survive registry
+    # GROWTH (new nodes registering unrelated attrs), which the old
+    # len(slot_of) cache-key term treated as a full invalidation.
+    attr_guard: List[Tuple[str, Optional[int]]] = field(default_factory=list)
+    dev_guard: List[Tuple[str, Optional[int]]] = field(default_factory=list)
 
 
 def _resolve_attr_name(target: str) -> Optional[str]:
@@ -187,7 +194,10 @@ class RequestEncoder:
     """Compiles task groups against a NodeMatrix's registries.
 
     Compilation results are cached per (job id, version, tg name) — the
-    reference re-runs constraint parsing per eval; we pay it once.
+    reference re-runs constraint parsing per eval; we pay it once.  Cached
+    entries carry slot guards (attr_guard/dev_guard) instead of keying on
+    registry size: steady-state evals hit the cache even while node
+    registrations keep growing the attr registry.
     """
 
     def __init__(self, matrix: NodeMatrix):
@@ -201,14 +211,26 @@ class RequestEncoder:
         algorithm: str = "binpack",
         preemption_enabled: bool = False,
     ) -> CompiledTaskGroup:
-        key = (job.id, job.version, tg.name, algorithm, preemption_enabled,
-               len(self.matrix.attrs.slot_of))
+        key = (job.id, job.version, tg.name, algorithm, preemption_enabled)
         hit = self._cache.get(key)
-        if hit is not None:
+        if hit is not None and self._guard_valid(hit):
             return hit
         compiled = self._compile(job, tg, algorithm, preemption_enabled)
         self._cache[key] = compiled
         return compiled
+
+    def _guard_valid(self, compiled: CompiledTaskGroup) -> bool:
+        """True while every slot resolution the compile made still holds
+        (registries are append-only, so in practice this only fails across
+        a matrix rebuild)."""
+        slot_of = self.matrix.attrs.slot_of
+        for name, slot in compiled.attr_guard:
+            if slot_of.get(name) != slot:
+                return False
+        for name, slot in compiled.dev_guard:
+            if self.matrix.devices.lookup(name) != slot:
+                return False
+        return True
 
     def _compile(
         self,
@@ -218,6 +240,13 @@ class RequestEncoder:
         preemption_enabled: bool,
     ) -> CompiledTaskGroup:
         attrs = self.matrix.attrs
+        attr_guard: List[Tuple[str, Optional[int]]] = []
+        dev_guard: List[Tuple[str, Optional[int]]] = []
+
+        def reg_attr(name: str) -> Optional[int]:
+            slot = attrs.register(name)
+            attr_guard.append((name, slot))
+            return slot
 
         # Constraint set = job + tg + all tasks (reference: stack.go SetJob /
         # feasibility wrapper collects all levels).
@@ -250,12 +279,12 @@ class RequestEncoder:
         # (reference: DriverChecker feasible.go:433; matrix stores "1" only
         # for detected+healthy drivers).
         for drv in drivers:
-            slot = attrs.register(f"driver.{drv}")
+            slot = reg_attr(f"driver.{drv}")
             if slot is not None:
                 emit(slot, OP_EQ, stable_hash("1"))
 
         for con in constraints:
-            if not self._encode_constraint(con, emit, escaped):
+            if not self._encode_constraint(con, emit, escaped, reg_attr):
                 escaped.append(self._escape(con))
 
         # Datacenter membership (reference: readyNodesInDCs, scheduler/util.go).
@@ -274,6 +303,7 @@ class RequestEncoder:
         escaped_devices: List[Tuple[str, int]] = []
         for name, count in tg.combined_devices().items():
             slot = self.matrix.devices.register(name)
+            dev_guard.append((name, slot))
             if slot is not None:
                 dev_ask[slot] += count
             else:
@@ -292,7 +322,9 @@ class RequestEncoder:
         a_weight = np.zeros((MAX_AFFINITIES,), np.float32)
         ai = 0
         for aff in affinities[:MAX_AFFINITIES]:
-            enc = self._encode_predicate(aff.l_target, aff.operand, aff.r_target)
+            enc = self._encode_predicate(
+                aff.l_target, aff.operand, aff.r_target, reg_attr
+            )
             if enc is None:
                 continue  # non-vectorizable affinity: skipped (soft signal)
             slot, op, h, num = enc
@@ -312,7 +344,7 @@ class RequestEncoder:
         total_count = float(tg.count)
         for si, sp in enumerate(spreads[:MAX_SPREADS]):
             name = _resolve_attr_name(sp.attribute)
-            slot = attrs.register(name) if name else None
+            slot = reg_attr(name) if name else None
             if slot is None:
                 continue
             s_slot[si] = slot
@@ -400,6 +432,8 @@ class RequestEncoder:
             csi_volumes=[
                 v for v in (tg.volumes or {}).values() if v.type == "csi"
             ],
+            attr_guard=attr_guard,
+            dev_guard=dev_guard,
         )
 
     # -- predicate encoding --------------------------------------------------
@@ -409,25 +443,30 @@ class RequestEncoder:
         unique = "unique." in name
         return EscapedConstraint(constraint=con, unique=unique)
 
-    def _encode_constraint(self, con: Constraint, emit, escaped) -> bool:
+    def _encode_constraint(self, con: Constraint, emit, escaped,
+                           reg_attr=None) -> bool:
         if con.operand in (Op.DISTINCT_HOSTS.value, Op.DISTINCT_PROPERTY.value):
             # Handled by dedicated host-side iterators (feasible.go:505,604).
             escaped.append(self._escape(con))
             return True
-        enc = self._encode_predicate(con.l_target, con.operand, con.r_target)
+        enc = self._encode_predicate(
+            con.l_target, con.operand, con.r_target, reg_attr
+        )
         if enc is None:
             return False
         slot, op, h, num = enc
         return emit(slot, op, h, num)
 
     def _encode_predicate(
-        self, l_target: str, operand: str, r_target: str
+        self, l_target: str, operand: str, r_target: str, reg_attr=None
     ) -> Optional[Tuple[int, int, int, float]]:
-        """Encode one predicate as (slot, op, hash, num); None = escape."""
+        """Encode one predicate as (slot, op, hash, num); None = escape.
+        ``reg_attr`` (compile-time recorder) defaults to the raw registry."""
         name = _resolve_attr_name(l_target)
         if name is None:
             return None
-        slot = self.matrix.attrs.register(name)
+        register = reg_attr or self.matrix.attrs.register
+        slot = register(name)
         if slot is None:
             return None  # registry exhausted — host fallback
 

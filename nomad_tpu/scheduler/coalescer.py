@@ -79,6 +79,10 @@ class _Pending:
     penalty: np.ndarray  # (N,) bool
     class_elig: np.ndarray  # (pad,) bool
     host_mask: np.ndarray  # (N,) bool
+    # Placements the caller will actually consume (0 = all scan_length).
+    # The jax kernel ignores it (static shapes); the fake-device twin stops
+    # its scan after this many live steps.
+    n_live: int = 0
     done: threading.Event = field(default_factory=threading.Event)
     outcome: Optional[PlaceOutcome] = None
     error: Optional[BaseException] = None
@@ -156,6 +160,7 @@ class DeviceCoalescer:
         class_elig: np.ndarray,
         host_mask: np.ndarray,
         timeout: float = 600.0,  # must cover a cold TPU jit compile
+        n_live: int = 0,
     ) -> PlaceOutcome:
         """Submit one placement request; blocks until its batch lands.
         The scan always runs ``scan_length`` steps — take ``rows[:k]``."""
@@ -168,6 +173,7 @@ class DeviceCoalescer:
             penalty=penalty,
             class_elig=class_elig,
             host_mask=host_mask,
+            n_live=n_live,
         )
         with self._cond:
             if self._stop.is_set():
@@ -257,8 +263,13 @@ class DeviceCoalescer:
                 )
             if not self._queue:
                 return None
-        # Linger briefly so concurrent workers land in one dispatch.
-        if self.linger_s:
+        # Linger briefly so concurrent workers land in one dispatch.  The
+        # fake-device backend answers synchronously, so lingering would only
+        # add serial latency on the one dispatch thread — requests still
+        # coalesce while a dispatch is in progress.
+        from ..ops import fake_device
+
+        if self.linger_s and not fake_device.enabled():
             self._stop.wait(self.linger_s)
         with self._cond:
             batch = self._queue[: self.max_lanes]
@@ -291,9 +302,13 @@ class DeviceCoalescer:
         return self.n_device_shards
 
     def _dispatch(self, batch: List[_Pending]):
-        import jax
+        from ..ops import fake_device
 
-        n_shards = self._resolve_sharding()
+        fake = fake_device.enabled()
+        if fake:
+            n_shards = 1
+        else:
+            n_shards = self._resolve_sharding()
         with DEVICE_LOCK:
             arrays = self.matrix.sync()
         n = int(arrays.used.shape[0])
@@ -325,6 +340,27 @@ class DeviceCoalescer:
                     p.class_elig,
                     np.ones((cw - p.class_elig.shape[0],), bool),
                 ])
+
+        if fake:
+            # Fake-device backend: numpy twins answer synchronously from
+            # the host snapshot.  No lane padding (shapes need not be
+            # static for numpy) and no stacking — the twin takes lists.
+            return fake_device.place_batch(
+                arrays,
+                arrays.used,
+                [p.delta_rows for p in batch],
+                [p.delta_vals for p in batch],
+                [p.tg_count for p in batch],
+                [p.spread_counts for p in batch],
+                [p.penalty for p in batch],
+                [p.request for p in batch],
+                [p.class_elig for p in batch],
+                [p.host_mask for p in batch],
+                n_placements=self.scan_length,
+                live_counts=[p.n_live or self.scan_length for p in batch],
+            )
+
+        import jax
 
         # Pad to the fixed lane count with inert copies of the first
         # request (host_mask all-False → every placement fails cheaply).

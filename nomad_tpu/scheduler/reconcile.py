@@ -16,6 +16,8 @@ update stanza, and canary placement/promotion bookkeeping.
 from __future__ import annotations
 
 import dataclasses
+
+import numpy as np
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -109,6 +111,32 @@ def tasks_updated(a: TaskGroup, b: TaskGroup) -> bool:
         ax.pop(k, None)
         bx.pop(k, None)
     return ax != bx
+
+
+# tasks_updated runs two deep dataclasses.asdict walks per version-mismatched
+# alloc; under a rolling update every alloc of the job hits the same
+# (old version, new version) pair.  A registered (job id, version) names an
+# immutable definition in the store, so the verdict is cacheable by version
+# pair.  Bounded FIFO so long-lived servers don't grow without limit.
+_tasks_updated_cache: Dict[Tuple[str, int, int, str], bool] = {}
+_TASKS_UPDATED_CACHE_MAX = 4096
+
+
+def tasks_updated_memo(old_job: Job, new_job: Job, tg_name: str) -> bool:
+    key = (old_job.id, old_job.version, new_job.version, tg_name)
+    hit = _tasks_updated_cache.get(key)
+    if hit is None:
+        old_tg = old_job.lookup_task_group(tg_name)
+        new_tg = new_job.lookup_task_group(tg_name)
+        hit = (
+            True
+            if old_tg is None or new_tg is None
+            else tasks_updated(old_tg, new_tg)
+        )
+        if len(_tasks_updated_cache) >= _TASKS_UPDATED_CACHE_MAX:
+            _tasks_updated_cache.pop(next(iter(_tasks_updated_cache)))
+        _tasks_updated_cache[key] = hit
+    return hit
 
 
 def reschedule_delay(policy, attempt: int) -> float:
@@ -317,24 +345,41 @@ class AllocReconciler:
         failed: List[Allocation] = []
         waiting: List[Allocation] = []  # pending delayed reschedule elsewhere
         terminal_by_name: Dict[str, Allocation] = {}
-        for a in allocs:
-            if (
-                a.desired_status == AllocDesiredStatus.RUN.value
-                and a.client_status == AllocClientStatus.FAILED.value
-                and not a.next_allocation
-            ):
+        n_allocs = len(allocs)
+        if n_allocs:
+            # Mask combination instead of per-alloc branch chains: one
+            # attribute sweep per predicate, then boolean algebra.  On jobs
+            # with hundreds of allocs this replaces the interpreted if/elif
+            # ladder with four numpy ops.
+            run_v = AllocDesiredStatus.RUN.value
+            fail_v = AllocClientStatus.FAILED.value
+            is_failed = np.fromiter(
+                (
+                    a.desired_status == run_v
+                    and a.client_status == fail_v
+                    and not a.next_allocation
+                    for a in allocs
+                ),
+                bool,
+                n_allocs,
+            )
+            is_terminal = np.fromiter(
+                (a.terminal_status() for a in allocs), bool, n_allocs
+            )
+            for i in np.flatnonzero(is_failed):
+                a = allocs[i]
                 # A follow-up eval owns this alloc until it fires; only the
                 # owning eval may reschedule it (updateByReschedulable).
                 if a.follow_up_eval_id and a.follow_up_eval_id != self.eval_id:
                     waiting.append(a)
                 else:
                     failed.append(a)
-            elif a.terminal_status():
+            for i in np.flatnonzero(~is_failed & is_terminal):
+                a = allocs[i]
                 prev = terminal_by_name.get(a.name)
                 if prev is None or prev.create_index < a.create_index:
                     terminal_by_name[a.name] = a
-            else:
-                live.append(a)
+            live = [allocs[i] for i in np.flatnonzero(~is_failed & ~is_terminal)]
 
         # -- tainted-node handling: migrate (drain, drainer-paced) or lost
         # (down/gone).  Draining nodes migrate ONLY the allocs the drainer
@@ -344,23 +389,41 @@ class AllocReconciler:
         untainted: List[Allocation] = []
         migrate: List[Allocation] = []
         lost: List[Allocation] = []
-        for a in live:
-            if a.node_id not in self.tainted:
-                # Drainer-forced migration arrives as a DesiredTransition
-                # (nomad/drainer/drainer.go:357).
-                if a.desired_transition.should_migrate():
-                    migrate.append(a)
+        if not self.tainted:
+            # Steady-state fast path: no tainted nodes — only the migrate
+            # transition can reroute an alloc, and the drainer stamps it
+            # rarely.  One mask sweep, no per-alloc dict probes.
+            if live:
+                wants_migrate = np.fromiter(
+                    (a.desired_transition.should_migrate() for a in live),
+                    bool,
+                    len(live),
+                )
+                if wants_migrate.any():
+                    migrate = [live[i] for i in np.flatnonzero(wants_migrate)]
+                    untainted = [
+                        live[i] for i in np.flatnonzero(~wants_migrate)
+                    ]
                 else:
-                    untainted.append(a)
-                continue
-            node = self.tainted[a.node_id]
-            if node is not None and node.drain:
-                if a.desired_transition.should_migrate():
-                    migrate.append(a)
+                    untainted = live
+        else:
+            for a in live:
+                if a.node_id not in self.tainted:
+                    # Drainer-forced migration arrives as a DesiredTransition
+                    # (nomad/drainer/drainer.go:357).
+                    if a.desired_transition.should_migrate():
+                        migrate.append(a)
+                    else:
+                        untainted.append(a)
+                    continue
+                node = self.tainted[a.node_id]
+                if node is not None and node.drain:
+                    if a.desired_transition.should_migrate():
+                        migrate.append(a)
+                    else:
+                        untainted.append(a)
                 else:
-                    untainted.append(a)
-            else:
-                lost.append(a)
+                    lost.append(a)
 
         # -- canaries of the current deployment are handled out-of-band of
         # the name bookkeeping below (reconcile.go cancelUnneededCanaries /
@@ -444,8 +507,7 @@ class AllocReconciler:
                 out.ignore += 1
                 desired["ignore"] += 1
                 continue
-            old_tg = a.job.lookup_task_group(tg.name) if a.job else None
-            if old_tg is not None and not tasks_updated(old_tg, tg):
+            if a.job is not None and not tasks_updated_memo(a.job, job, tg.name):
                 inplace.append(a)
             else:
                 destructive.append(a)

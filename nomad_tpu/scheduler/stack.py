@@ -29,7 +29,7 @@ from ..ops.encode import (
     RequestEncoder,
     pow2_bucket as _pow2_bucket,
 )
-from ..ops import kernels
+from ..ops import fake_device, kernels
 from ..state.matrix import DEVICE_LOCK, NodeMatrix, node_attributes, stable_hash
 from ..structs.types import (
     Allocation,
@@ -116,7 +116,9 @@ class GenericStack:
         self.algorithm = algorithm
         self.preemption_enabled = preemption_enabled
         self.batch = batch
-        self.encoder = RequestEncoder(matrix)
+        # Shared, matrix-lifetime encoder: stacks are rebuilt per eval, so a
+        # per-stack encoder would discard the compile cache every eval.
+        self.encoder: RequestEncoder = matrix.shared_encoder()
         self.job: Optional[Job] = None
         # Eligibility telemetry consumed by blocked-eval creation
         # (reference: EvalEligibility, context.go:190; fills the eval's
@@ -415,6 +417,14 @@ class GenericStack:
         conflict (NetworkIndex equivalent, nomad/structs/network.go:35).
         ``extra_used``: ports handed out earlier in the same select batch,
         before the plan reflects them."""
+        if not tg.networks and not any(
+            t.resources.networks for t in tg.tasks
+        ):
+            # No port asks — skip the proposed-allocs walk entirely.  That
+            # walk (every live alloc on the node, through the MVCC snapshot
+            # wrapper) was the single hottest worker frame for port-less
+            # jobs, which place on every node the kernel picks.
+            return {}
         used = set(node.reserved.reserved_ports)
         if extra_used:
             used |= extra_used
@@ -515,7 +525,9 @@ class GenericStack:
                 spread_counts,
                 penalty,
                 class_elig,
-                _full_mask(n, host_mask),
+                host_mask if host_mask is not None
+                else self.matrix.shared_masks()[1],
+                n_live=remaining,
             )
             return (
                 out.rows, out.scores, out.binpack, out.preempted,
@@ -526,11 +538,29 @@ class GenericStack:
         # coalescer present (live server) the closure still executes on ITS
         # thread — the tunnel client wedges under concurrent device use.
         def dev_op():
-            import jax.numpy as jnp
-
             arrays = self.matrix.sync()
             n_dev = int(arrays.used.shape[0])
             bucket = min(_pow2_bucket(remaining), PLACEMENT_CHUNK)
+            if fake_device.enabled():
+                result = fake_device.place_task_group(
+                    arrays,
+                    compiled.request,
+                    fake_device.dense_used0(arrays, deltas),
+                    _pad_width(tg_count, n_dev, 0),
+                    spread_counts,
+                    _pad_width(penalty, n_dev, False),
+                    class_elig,
+                    _pad_width(_full_mask(n, host_mask), n_dev, False),
+                    n_placements=bucket,
+                )
+                return (
+                    result.rows, result.scores, result.binpack,
+                    result.preempted, result.nodes_evaluated,
+                    result.nodes_filtered, result.nodes_exhausted,
+                )
+
+            import jax.numpy as jnp
+
             result = kernels.place_task_group(
                 arrays,
                 compiled.request,
@@ -575,11 +605,16 @@ class GenericStack:
 
         n = self.matrix.capacity
 
-        penalty = np.zeros((n,), bool)
-        for node_id in penalty_nodes or []:
-            row = self.matrix.row_of.get(node_id)
-            if row is not None:
-                penalty[row] = True
+        if penalty_nodes:
+            penalty = np.zeros((n,), bool)
+            for node_id in penalty_nodes:
+                row = self.matrix.row_of.get(node_id)
+                if row is not None:
+                    penalty[row] = True
+        else:
+            # Steady state: no penalized nodes — reuse the matrix-wide
+            # read-only all-False mask instead of allocating per eval.
+            penalty = self.matrix.shared_masks()[0]
 
         class_elig = self._class_eligibility(compiled)
         base_host_mask = self._host_mask(job, tg, compiled)
@@ -620,9 +655,14 @@ class GenericStack:
             tg_counts = self._tg_counts(job, tg)
             for row in chosen_rows:
                 tg_counts[row] = tg_counts.get(row, 0) + 1
-            tg_count = np.zeros((n,), np.int32)
-            for row, c in tg_counts.items():
-                tg_count[row] = c
+            if tg_counts:
+                tg_count = np.zeros((n,), np.int32)
+                for row, c in tg_counts.items():
+                    tg_count[row] = c
+            else:
+                # First placement pass of a fresh job: no proposed allocs
+                # anywhere — reuse the matrix-wide read-only zero vector.
+                tg_count = self.matrix.shared_zero_i32()
 
             spread_counts = self._spread_counts(job, tg, compiled)
 
@@ -739,10 +779,19 @@ class SystemStack(GenericStack):
             d -= np.array([r.cpu, r.memory_mb, r.disk_mb], np.float32)
 
         def dev_op():
-            import jax.numpy as jnp
-
             arrays = self.matrix.sync()
             n_dev = int(arrays.used.shape[0])
+            if fake_device.enabled():
+                return fake_device.system_feasible(
+                    arrays,
+                    fake_device.dense_used0(arrays, deltas),
+                    compiled.request,
+                    class_elig,
+                    _pad_width(_full_mask(n, host_mask), n_dev, False),
+                )
+
+            import jax.numpy as jnp
+
             # One stacked (2, N) result = one device→host fetch (each
             # separate fetch costs a tunnel round-trip).
             return np.asarray(kernels.system_feasible(

@@ -79,7 +79,15 @@ class NodeDrainer:
                 if fd:
                     timeout = min(timeout, max(0.0, fd - time.time()))
             self._wake.clear()
-            store.wait_for_table("allocs", index, timeout=max(timeout, 0.01))
+            if draining:
+                store.wait_for_table(
+                    "allocs", index, timeout=max(timeout, 0.01)
+                )
+            else:
+                # Idle (no draining node): drain starts are discovered by
+                # the 1s poll either way, so don't ride the allocs watch —
+                # it wakes this thread on every plan apply for nothing.
+                self._wake.wait(timeout=timeout)
             index = store.table_index("allocs")
             if self._shutdown.is_set():
                 return

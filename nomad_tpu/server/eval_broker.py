@@ -23,7 +23,6 @@ import heapq
 import itertools
 import threading
 import time
-import uuid
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..structs.types import EvalStatus, Evaluation
@@ -213,22 +212,23 @@ class EvalBroker:
                 if self._enabled:
                     ev = self._pop_ready_locked(schedulers)
                     if ev is not None:
-                        token = uuid.uuid4().hex
+                        token = "tok-%x" % next(self._seq)
                         count = self._attempts.get(ev.id, 0) + 1
                         self._attempts[ev.id] = count
                         self._unack[ev.id] = _Unack(
                             ev, token, time.time() + self.nack_timeout
                         )
                         return ev, token
+                # Expired-nack requeues are the watcher thread's job (it
+                # notifies when it moves anything), so waiters here sleep
+                # for their full remaining timeout instead of 1s-capped
+                # poll wakeups that each swept the unack table.
                 wait = None
                 if deadline is not None:
                     wait = deadline - time.time()
                     if wait <= 0:
                         return None, ""
-                else:
-                    wait = 1.0  # bounded waits so nack sweeps run
-                self._cond.wait(timeout=min(wait, 1.0))
-                self._sweep_nacks_locked()
+                self._cond.wait(timeout=wait)
 
     def _pop_ready_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
         # Highest priority across the requested queues (DequeueEval scan).
@@ -283,7 +283,7 @@ class EvalBroker:
                 self._ready.setdefault(queue, _ReadyQueue()).push(ev)
             self._cond.notify_all()
 
-    def _sweep_nacks_locked(self) -> None:
+    def _sweep_nacks_locked(self) -> bool:
         now = time.time()
         expired = [u for u in self._unack.values() if u.deadline <= now]
         for un in expired:
@@ -294,28 +294,36 @@ class EvalBroker:
                 self._ready.setdefault(FAILED_QUEUE, _ReadyQueue()).push(ev)
             else:
                 self._ready.setdefault(ev.type or "service", _ReadyQueue()).push(ev)
+        return bool(expired)
 
     # ------------------------------------------------------------------
     # Delay heap watcher
     # ------------------------------------------------------------------
 
     def _run_delayed_watcher(self) -> None:
-        while True:
-            with self._lock:
+        """Service the delay heap AND requeue expired nacks — the single
+        housekeeping thread, so dequeue waiters never have to poll.  Waits
+        on the broker condvar (instead of sleeping unlocked) so a freshly
+        enqueued delayed eval shortens the nap immediately."""
+        with self._lock:
+            while True:
                 if self._shutdown or not self._enabled:
                     return
                 now = time.time()
-                moved = False
+                moved = self._sweep_nacks_locked()
                 while self._delayed and self._delayed[0][0] <= now:
                     _, _, ev = heapq.heappop(self._delayed)
                     self._enqueue_ready_locked(ev)
                     moved = True
                 if moved:
                     self._cond.notify_all()
-                sleep_for = 0.5
+                wait_for = 0.5
                 if self._delayed:
-                    sleep_for = min(sleep_for, max(0.0, self._delayed[0][0] - now))
-            time.sleep(max(sleep_for, 0.01))
+                    wait_for = min(wait_for, max(0.0, self._delayed[0][0] - now))
+                if self._unack:
+                    nxt = min(u.deadline for u in self._unack.values())
+                    wait_for = min(wait_for, max(0.0, nxt - now))
+                self._cond.wait(timeout=max(wait_for, 0.01))
 
     # ------------------------------------------------------------------
     # Introspection

@@ -65,14 +65,56 @@ class PlanApplier:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            pending = self.queue.dequeue(timeout=0.2)
-            if pending is None:
+            batch = self.queue.dequeue_all(timeout=0.2)
+            if batch:
+                self.apply_batch(batch)
+
+    def apply_batch(self, batch: List[PendingPlan]) -> None:
+        """Commit a drained queue batch under ONE _write_lock → _lock
+        acquisition.  Each plan is still verified against the state left by
+        the plans committed before it (the _apply_locked loop is strictly
+        sequential), so the outcome matches the one-at-a-time loop; only the
+        per-plan lock round-trip is amortized."""
+        broker = self.server.eval_broker
+        store = self.server.store
+        staged: List[PendingPlan] = []
+        for pending in batch:
+            plan = pending.plan
+            if plan.eval_token and broker.enabled:
+                current = broker.outstanding_token(plan.eval_id)
+                if current != plan.eval_token:
+                    pending.respond(
+                        None,
+                        StaleEvalTokenError(
+                            f"plan for eval {plan.eval_id} has a stale token"
+                        ),
+                    )
+                    continue
+            staged.append(pending)
+        if not staged:
+            return
+
+        outcomes = []
+        with self.server.metrics.timer("nomad.plan.apply").time():
+            with store._write_lock:
+                with store._lock:
+                    for pending in staged:
+                        try:
+                            result, index = self._apply_locked(pending.plan)
+                            outcomes.append((pending, result, index, None))
+                        except Exception as exc:  # noqa: BLE001
+                            outcomes.append((pending, None, 0, exc))
+        for pending, result, index, exc in outcomes:
+            if exc is not None:
+                pending.respond(None, exc)
                 continue
             try:
-                result = self.apply(pending.plan)
-                pending.respond(result, None)
-            except Exception as exc:  # noqa: BLE001 — fail the submission
-                pending.respond(None, exc)
+                if index:
+                    self.server.on_plan_applied(pending.plan, result, index)
+            except Exception as exc2:  # noqa: BLE001
+                pending.respond(None, exc2)
+                continue
+            pending.respond(result, None)
 
     # ------------------------------------------------------------------
 

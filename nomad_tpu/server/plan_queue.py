@@ -89,6 +89,22 @@ class PlanQueue:
                 return None
             return heapq.heappop(self._heap)[2]
 
+    def dequeue_all(self, timeout: Optional[float] = None) -> List[PendingPlan]:
+        """Block for the first pending plan, then drain everything queued —
+        the applier commits the whole batch under one store-lock acquisition
+        instead of paying the lock round-trip per plan."""
+        with self._lock:
+            if not self._cond.wait_for(
+                lambda: self._heap or self._shutdown, timeout=timeout
+            ):
+                return []
+            if self._shutdown or not self._heap:
+                return []
+            batch = []
+            while self._heap:
+                batch.append(heapq.heappop(self._heap)[2])
+            return batch
+
     def depth(self) -> int:
         with self._lock:
             return len(self._heap)

@@ -293,6 +293,48 @@ class NodeMatrix:
         # was cleared WITHOUT ever reaching the device, leaving (e.g.) a
         # freshly registered node invisible to every subsequent dispatch.
         self._host_lock = threading.Lock()
+        self._encoder = None
+        self._shared_masks: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._shared_zero_i32: Optional[np.ndarray] = None
+
+    def shared_encoder(self):
+        """The matrix-wide RequestEncoder.  Scheduling stacks are built per
+        eval; a per-stack encoder made the compile cache die with each eval,
+        so steady-state evals recompiled every constraint set.  The shared
+        instance is safe: per-job broker serialization means no two live
+        evals compile/mutate the same (job, tg) entry concurrently."""
+        enc = self._encoder
+        if enc is None:
+            from ..ops.encode import RequestEncoder
+
+            enc = self._encoder = RequestEncoder(self)
+        return enc
+
+    def shared_masks(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(all-False, all-True) read-only (capacity,) bool masks — select
+        assembly reuses them instead of allocating fresh vectors per eval.
+        Rebuilt when capacity grows; marked non-writeable so an accidental
+        in-place mutation raises instead of corrupting a neighbor select."""
+        n = self.capacity
+        m = self._shared_masks
+        if m is None or m[0].shape[0] != n:
+            zeros = np.zeros((n,), bool)
+            ones = np.ones((n,), bool)
+            zeros.setflags(write=False)
+            ones.setflags(write=False)
+            m = self._shared_masks = (zeros, ones)
+        return m
+
+    def shared_zero_i32(self) -> np.ndarray:
+        """Read-only all-zero (capacity,) int32 — the tg_count vector for
+        evals whose job has no proposed allocs yet (the common first pass)."""
+        n = self.capacity
+        z = self._shared_zero_i32
+        if z is None or z.shape[0] != n:
+            z = np.zeros((n,), np.int32)
+            z.setflags(write=False)
+            self._shared_zero_i32 = z
+        return z
 
     # -- host arrays --------------------------------------------------------
 
@@ -563,7 +605,15 @@ class NodeMatrix:
             return self._sync_locked()
 
     def _sync_locked(self) -> DeviceArrays:
-        import jax
+        from ..ops import fake_device
+
+        fake = fake_device.enabled()
+        if self._device is not None and (
+            isinstance(self._device.used, np.ndarray) != fake
+        ):
+            # Backend flipped (tests toggle the env var): the cached
+            # snapshot is the wrong flavor — rebuild from the host arrays.
+            self._device_valid = False
 
         # Snapshot the dirty rows' data under the host lock (mutators may
         # run concurrently from the store); the device transfer itself
@@ -580,7 +630,16 @@ class NodeMatrix:
                 # the transfer would clobber that invalidation and leave
                 # post-growth rows silently out of device bounds.
                 self._device_valid = True
+            if fake:
+                # Fake-device backend: the "device snapshot" is the host
+                # copy itself; dispatches consume it synchronously on the
+                # coalescer thread before the next sync can scatter into
+                # it, so no further copies are needed.
+                self._device = DeviceArrays(**host_copy)
+                return self._device
             try:
+                import jax
+
                 # One pytree transfer, not 12 per-field round-trips.
                 dev = jax.device_put(host_copy)
                 self._device = DeviceArrays(
@@ -598,6 +657,12 @@ class NodeMatrix:
                 return self._device
             rows = np.fromiter(self._dirty, np.int32)
             self._dirty.clear()
+            if fake and isinstance(self._device.used, np.ndarray):
+                # Numpy snapshot: scatter the dirty rows in place (same
+                # O(dirty rows) incremental cost as the device path).
+                for f in DeviceArrays._fields:
+                    getattr(self._device, f)[rows] = self._alloc[f][rows]
+                return self._device
             # Pad the row count to a pow2 bucket (repeating row 0 — the
             # duplicate scatter writes identical data) so the jitted
             # scatter compiles once per bucket; the numpy operands ride

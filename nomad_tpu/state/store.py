@@ -171,6 +171,13 @@ class StateStore:
         # proceed); reentrant because mutators nest (@journaled).
         self._write_lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
+        # Index watchers (worker snapshot-sync, blocking queries) wait on a
+        # dedicated leaf condvar so they never contend on — nor get woken
+        # into — the global store lock.  The predicate reads the
+        # authoritative counters unlocked (GIL-atomic int/dict reads);
+        # _bump notifies under the watch lock, which orders the notify
+        # after any waiter's failed predicate check (no lost wakeups).
+        self._watch_cond = threading.Condition(threading.Lock())
         self.matrix = matrix if matrix is not None else NodeMatrix()
 
         # Durability seam (attach_wal): top-level mutations journal through
@@ -250,16 +257,21 @@ class StateStore:
     def _bump(self, table: str, index: int) -> None:
         self.latest_index = max(self.latest_index, index)
         self._table_index[table] = max(self._table_index.get(table, 0), index)
-        self._cond.notify_all()
+        with self._watch_cond:
+            self._watch_cond.notify_all()
 
     def table_index(self, table: str) -> int:
         with self._lock:
             return self._table_index.get(table, 0)
 
     def wait_for_index(self, index: int, timeout: Optional[float] = None) -> bool:
-        """Block until ``latest_index >= index`` (worker.go:228 sync point)."""
-        with self._cond:
-            return self._cond.wait_for(
+        """Block until ``latest_index >= index`` (worker.go:228 sync point).
+        Waits on the watch condvar, NOT the store lock — a snapshot-syncing
+        worker costs writers nothing while it waits."""
+        if self.latest_index >= index:  # fast path: already caught up
+            return True
+        with self._watch_cond:
+            return self._watch_cond.wait_for(
                 lambda: self.latest_index >= index, timeout=timeout
             )
 
@@ -268,8 +280,8 @@ class StateStore:
     ) -> int:
         """Blocking query: wait until a table index exceeds ``min_index``;
         returns the current table index (memdb WatchSet equivalent)."""
-        with self._cond:
-            self._cond.wait_for(
+        with self._watch_cond:
+            self._watch_cond.wait_for(
                 lambda: self._table_index.get(table, 0) > min_index,
                 timeout=timeout,
             )

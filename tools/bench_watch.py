@@ -1,0 +1,132 @@
+"""Probe-then-bench retry loop: land the TPU evidence artifact.
+
+The TPU tunnel wedges for long stretches (VERDICT rounds 2/4/5): a bench
+started while it is wedged burns its whole probe budget and falls back to
+CPU, so no TPU-platform artifact has ever been committed.  This watcher
+inverts the loop — probe CHEAPLY first (one disposable subprocess, hard
+timeout), and only when a probe comes back healthy pay for the full bench
+run.  On the first bench that reports ``platform != cpu`` the raw JSON is
+written to ``BENCH_tpu_evidence.json`` at the repo root — the artifact
+PARITY.md's ≥50K claim is waiting on.
+
+Usage:
+    python tools/bench_watch.py [--attempts N] [--interval S] [--once]
+
+Exit codes: 0 = evidence written (or already present), 1 = budget
+exhausted without a TPU bench, 2 = bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE = os.path.join(REPO, "BENCH_tpu_evidence.json")
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+# The bench itself retries internally; this bound only reaps a run that
+# wedges mid-flight AFTER a healthy probe (observed failure mode: tunnel
+# dies between probe and pipelined phase).
+BENCH_TIMEOUT = int(os.environ.get("BENCH_WATCH_BENCH_TIMEOUT", "1800"))
+
+
+def probe() -> str:
+    """One disposable-subprocess backend probe; returns the platform name
+    ('tpu', 'cpu', ...) or an error string prefixed with 'err:'."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return f"err:hung >{PROBE_TIMEOUT}s (wedged tunnel?)"
+    if p.returncode != 0:
+        return f"err:rc={p.returncode}: {p.stderr.strip()[-200:]}"
+    return p.stdout.strip()
+
+
+def run_bench() -> dict | None:
+    """One full bench run; returns the parsed result JSON or None."""
+    env = dict(os.environ)
+    # The probe already succeeded — skip the bench's own 4-attempt probe
+    # ladder so a mid-run wedge fails fast into THIS loop's next attempt.
+    env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench_watch: bench hung >{BENCH_TIMEOUT}s\n")
+        return None
+    # The result is the LAST json line on stdout (breadcrumbs go to stderr).
+    for line in reversed(p.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    sys.stderr.write(
+        f"bench_watch: no JSON in bench output (rc={p.returncode}); "
+        f"stderr tail: {p.stderr.strip()[-300:]}\n"
+    )
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--attempts", type=int, default=12,
+                    help="max probe attempts (default 12)")
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between failed probes (default 300)")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+bench attempt, no retry loop")
+    args = ap.parse_args()
+
+    if os.path.exists(EVIDENCE):
+        sys.stderr.write(f"bench_watch: {EVIDENCE} already present\n")
+        return 0
+
+    attempts = 1 if args.once else args.attempts
+    for attempt in range(1, attempts + 1):
+        plat = probe()
+        sys.stderr.write(
+            f"bench_watch: probe {attempt}/{attempts}: {plat}\n"
+        )
+        if plat and not plat.startswith("err:") and plat != "cpu":
+            result = run_bench()
+            if result is not None and result.get("platform") != "cpu":
+                result["captured_by"] = "tools/bench_watch.py"
+                result["captured_at"] = time.strftime(
+                    "%Y-%m-%dT%H:%M:%S%z"
+                )
+                tmp = EVIDENCE + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(result, fh, indent=2)
+                    fh.write("\n")
+                os.replace(tmp, EVIDENCE)
+                sys.stderr.write(
+                    f"bench_watch: evidence written -> {EVIDENCE} "
+                    f"(value={result.get('value')})\n"
+                )
+                return 0
+            sys.stderr.write(
+                "bench_watch: probe was healthy but the bench run "
+                "fell back / died; retrying\n"
+            )
+        if attempt < attempts:
+            time.sleep(args.interval)
+    sys.stderr.write("bench_watch: budget exhausted, no TPU evidence\n")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,7 @@ def main() -> None:
     evals = [srv.submit_job(make_job(i)) for i in range(N_JOBS)]
     pending = {e.id for e in evals}
     deadline = time.time() + 300.0
+    last_index = 0
     while pending and time.time() < deadline:
         done = {
             eid for eid in pending
@@ -142,7 +143,14 @@ def main() -> None:
             and e.terminal_status()
         }
         pending -= done
-        time.sleep(0.01)
+        if not pending:
+            break
+        # Condvar wait on the evals table instead of a 10ms sleep-poll:
+        # wakes on the next eval write, so completion latency isn't
+        # quantized to the poll period.
+        last_index = srv.store.wait_for_table(
+            "evals", last_index, timeout=0.25
+        )
     wall = time.time() - t0
     sampler.stop()
     rate = (N_JOBS - len(pending)) / wall
