@@ -64,6 +64,19 @@ HOST_ONLY_NODES = int(os.environ.get("BENCH_HOST_NODES", "2000"))
 HOST_ONLY_JOBS = int(os.environ.get("BENCH_HOST_JOBS", "1024"))
 HOST_ONLY_WORKERS = int(os.environ.get("BENCH_HOST_WORKERS", "8"))
 
+# Live-pipeline phase knobs (see bench_live_pipeline): lane cap stays SMALL
+# so pipeline depth — not lane coalescing — is the concurrency lever, and
+# workers ≥ max_depth × lanes so every pipeline slot can fill.
+LIVE_PIPELINE = os.environ.get("BENCH_LIVE_PIPELINE", "1") != "0"
+LIVE_DEPTHS = tuple(
+    int(d) for d in os.environ.get("BENCH_LIVE_DEPTHS", "1,4,8").split(",")
+)
+LIVE_LATENCY_MS = float(os.environ.get("BENCH_LIVE_LATENCY_MS", "65"))
+LIVE_JOBS = int(os.environ.get("BENCH_LIVE_JOBS", "96"))
+LIVE_NODES = int(os.environ.get("BENCH_LIVE_NODES", "256"))
+LIVE_LANES = int(os.environ.get("BENCH_LIVE_LANES", "2"))
+LIVE_WORKERS = int(os.environ.get("BENCH_LIVE_WORKERS", "16"))
+
 
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 # Total probe budget ~10 minutes: 4 attempts x 150s + backoffs (15/30/60).
@@ -675,6 +688,111 @@ def bench_host_only(result: dict) -> None:
             os.environ["NOMAD_TPU_FAKE_DEVICE"] = prev
 
 
+def bench_live_pipeline(result: dict) -> None:
+    """The LIVE server loop under a synthetic tunnel RTT, swept over
+    coalescer pipeline depths.
+
+    Fake-device backend with NOMAD_TPU_FAKE_DEVICE_LATENCY_MS: every
+    dispatch's RESULT arrives LIVE_LATENCY_MS after launch (the latency is
+    charged at resolve time, like the real tunnel's device→host fetch), so
+    the phase proves — without a TPU — that the coalescer's pipelined
+    producer/consumer loop overlaps in-flight dispatches: depth d sustains
+    ~d×lanes evals per RTT where the old serial loop managed lanes per RTT
+    regardless of depth.  Lane cap is deliberately small (LIVE_LANES) so
+    lane coalescing can't mask the depth effect."""
+    from nomad_tpu import mock
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    prev_fake = os.environ.get("NOMAD_TPU_FAKE_DEVICE")
+    prev_lat = os.environ.get("NOMAD_TPU_FAKE_DEVICE_LATENCY_MS")
+    os.environ["NOMAD_TPU_FAKE_DEVICE"] = "1"
+    os.environ["NOMAD_TPU_FAKE_DEVICE_LATENCY_MS"] = str(LIVE_LATENCY_MS)
+
+    def make_job(i: int):
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = E2E_GROUP_COUNT
+        tg.tasks[0].resources.cpu = 50 + 25 * (i % 4)
+        tg.tasks[0].resources.memory_mb = 64 + 32 * (i % 3)
+        return job
+
+    def one_depth(depth: int) -> float:
+        srv = Server(ServerConfig(
+            num_workers=LIVE_WORKERS,
+            node_capacity=max(256, 1 << (LIVE_NODES - 1).bit_length()),
+            coalescer_lanes=LIVE_LANES,
+            pipeline_depth=depth,
+            heartbeat_min_ttl=3600.0,
+            heartbeat_max_ttl=7200.0,
+        ))
+        srv.start()
+        try:
+            rng = np.random.default_rng(7)
+            for i in range(LIVE_NODES):
+                node = mock.node()
+                node.node_class = f"class-{i % 6}"
+                srv.register_node(node)
+            with srv.matrix._host_lock:
+                host = srv.matrix.snapshot_host()
+                host["used"][:LIVE_NODES] = (
+                    rng.uniform(0.1, 0.6, (LIVE_NODES, 3))
+                    * host["totals"][:LIVE_NODES]
+                )
+                srv.matrix._dirty.update(range(LIVE_NODES))
+            ev = srv.submit_job(make_job(0))
+            srv.wait_for_eval(ev.id, timeout=120.0)
+
+            t0 = time.time()
+            evals = [srv.submit_job(make_job(i)) for i in range(LIVE_JOBS)]
+            pending = {e.id for e in evals}
+            deadline = time.time() + 120.0
+            last_index = 0
+            while pending and time.time() < deadline:
+                pending = {
+                    eid for eid in pending
+                    if not (
+                        (e := srv.store.eval_by_id(eid)) is not None
+                        and e.terminal_status()
+                    )
+                }
+                if not pending:
+                    break
+                last_index = srv.store.wait_for_table(
+                    "evals", last_index, timeout=0.25
+                )
+            wall = time.time() - t0
+            return (LIVE_JOBS - len(pending)) / wall
+        finally:
+            srv.shutdown()
+
+    try:
+        rates = {}
+        for depth in LIVE_DEPTHS:
+            rates[depth] = round(one_depth(depth), 1)
+            result[f"live_pipeline_evals_per_sec_depth{depth}"] = rates[depth]
+        result.update(
+            live_pipeline_latency_ms=LIVE_LATENCY_MS,
+            live_pipeline_jobs=LIVE_JOBS,
+            live_pipeline_nodes=LIVE_NODES,
+            live_pipeline_lanes=LIVE_LANES,
+            live_pipeline_workers=LIVE_WORKERS,
+        )
+        base = rates.get(min(rates))
+        if base:
+            result["live_pipeline_speedup"] = round(
+                rates[max(rates)] / base, 2
+            )
+    finally:
+        for key, prev in (
+            ("NOMAD_TPU_FAKE_DEVICE", prev_fake),
+            ("NOMAD_TPU_FAKE_DEVICE_LATENCY_MS", prev_lat),
+        ):
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+
+
 def main() -> None:
     t_setup = time.time()
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -726,6 +844,14 @@ def main() -> None:
 
             traceback.print_exc()
             result["e2e_host_only_error"] = f"{type(e).__name__}: {e}"
+    if LIVE_PIPELINE:
+        try:
+            bench_live_pipeline(result)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            result["live_pipeline_error"] = f"{type(e).__name__}: {e}"
     result["total_s"] = round(time.time() - t_setup, 1)
     print(json.dumps(result))
 

@@ -23,6 +23,7 @@ result layout).  Two exact-output shortcuts keep them fast:
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
 import numpy as np
@@ -49,11 +50,48 @@ PREEMPTION_RATE = 0.0048
 PREEMPTION_ORIGIN = 2048.0
 
 _ENV = "NOMAD_TPU_FAKE_DEVICE"
+_LATENCY_ENV = "NOMAD_TPU_FAKE_DEVICE_LATENCY_MS"
 
 
 def enabled() -> bool:
     """True when the fake-device backend is active (env-gated)."""
     return os.environ.get(_ENV, "") == "1"
+
+
+def latency_s() -> float:
+    """Synthetic device→host fetch latency (seconds), from
+    ``NOMAD_TPU_FAKE_DEVICE_LATENCY_MS``.
+
+    Models the TPU tunnel's RTT the way JAX async dispatch exposes it:
+    launching a computation is cheap, *fetching* its result blocks for the
+    round-trip.  The coalescer therefore wraps fake dispatch results in a
+    :class:`DeferredResult` whose clock starts at launch — overlapping
+    in-flight dispatches overlap their latency windows exactly like real
+    pipelined fetches, which is what makes pipeline speedup provable in CI
+    without the (flaky) tunnel."""
+    try:
+        ms = float(os.environ.get(_LATENCY_ENV, "0") or "0")
+    except ValueError:
+        return 0.0
+    return max(0.0, ms) / 1000.0
+
+
+class DeferredResult:
+    """A fake in-flight dispatch: the value is already computed, but
+    ``result()`` blocks until ``launched_at + latency`` — the fake twin of
+    ``np.asarray`` on an async jax array."""
+
+    __slots__ = ("value", "ready_at")
+
+    def __init__(self, value, latency: float):
+        self.value = value
+        self.ready_at = time.monotonic() + latency
+
+    def result(self):
+        remaining = self.ready_at - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+        return self.value
 
 
 # ---------------------------------------------------------------------------

@@ -668,8 +668,7 @@ PACKED_EXHAUSTED = 6
 PACKED_WIDTH = 7
 
 
-@functools.partial(jax.jit, static_argnames=("n_placements",))
-def place_batch(
+def _place_batch_impl(
     arrays,
     used,
     delta_rows,
@@ -724,6 +723,26 @@ def place_batch(
         delta_rows, delta_vals, tg_counts, spread_counts, penalties, reqs,
         class_eligs, host_masks,
     )
+
+
+place_batch = functools.partial(jax.jit, static_argnames=("n_placements",))(
+    _place_batch_impl
+)
+
+# The coalescer's entry point: identical computation, but the per-dispatch
+# lane operands (deltas, tg/spread counts, penalties, stacked requests,
+# class eligibility, host masks — argnums 2..9) are DONATED, so XLA reuses
+# their freshly-transferred device buffers as scratch instead of holding
+# them live alongside the outputs. ``arrays``/``used`` (argnums 0-1) are
+# never donated: they are matrix-resident and shared with other in-flight
+# pipelined dispatches. Kept separate from ``place_batch`` because callers
+# of the un-donated entry (tests, tools) legitimately reuse their input
+# arrays across calls.
+place_batch_live = functools.partial(
+    jax.jit,
+    static_argnames=("n_placements",),
+    donate_argnums=tuple(range(2, 10)),
+)(_place_batch_impl)
 
 
 # ---------------------------------------------------------------------------

@@ -185,6 +185,32 @@ def shard_matrix_arrays(mesh: Mesh, arrays: DeviceArrays) -> DeviceArrays:
     )
 
 
+def make_sharded_row_scatter(mesh: Mesh):
+    """Build the jitted dirty-row scatter into a mesh-RESIDENT matrix.
+
+    ``scatter(device, idx, *row_data) -> DeviceArrays`` updates rows
+    ``idx`` of the sharded snapshot with fresh host values; out_shardings
+    pins every output leaf to the same 'node' layout, so XLA routes each
+    row to the shard that owns it — the incremental alternative to
+    re-laying the full matrix through ``shard_matrix_arrays`` per dispatch
+    (state/matrix.py sync_sharded).  No donation: in-flight pipelined
+    dispatches may still be reading the previous snapshot's buffers.
+    """
+    out_shardings = DeviceArrays(
+        *(NamedSharding(mesh, spec) for spec in _ARRAYS_SPEC)
+    )
+
+    def scat(d, i, *vals):
+        return DeviceArrays(
+            **{
+                f: getattr(d, f).at[i].set(v)
+                for f, v in zip(DeviceArrays._fields, vals)
+            }
+        )
+
+    return jax.jit(scat, out_shardings=out_shardings)
+
+
 def _step_local(arrays, used, tg_counts, spread_counts, penalties, reqs,
                 class_eligs, host_masks):
     """Per-shard body. Local shapes: arrays/used are (N/n, ...); batched

@@ -1,4 +1,4 @@
-"""Dispatch coalescer — one device thread batching concurrent selects.
+"""Dispatch coalescer — pipelined device dispatch for concurrent selects.
 
 Round-3 diagnosis: every worker's ``select()`` held the global DEVICE_LOCK
 across its own kernel dispatch, and fetched seven result buffers
@@ -8,19 +8,37 @@ serialized into ~1.5 evals/sec end-to-end while the batched kernel sat
 unused outside the bench.
 
 This module makes the batched kernel THE live path: workers enqueue
-compiled placement requests and block on a future; a single device thread
+compiled placement requests and block on a future; a dispatch thread
 drains the queue, stacks up to ``max_lanes`` requests, and issues ONE
 ``ops.kernels.place_batch`` dispatch whose packed result costs ONE fetch.
-Up to ``max_inflight`` dispatches are kept in flight so the tunnel
-round-trip amortizes across batches (the same pipelining bench.py
-measures).
+
+Round-6 diagnosis: the dispatch thread itself performed that fetch
+(``np.asarray`` blocks for the tunnel RTT), so exactly one dispatch was
+ever in flight and the live path could never reach the pipelined rate the
+bench proves (depth 8 amortizes the RTT → 62K evals/s).  The loop is now
+a producer/consumer pipeline:
+
+* the **dispatch thread** only launches — it relies on JAX async dispatch
+  and never calls ``np.asarray``.  Up to ``pipeline_depth`` launches
+  (default 8, env ``NOMAD_TPU_PIPELINE_DEPTH``) overlap; the bounded
+  ticket queue provides backpressure.
+* a **resolver thread** performs the blocking device→host fetch for each
+  in-flight ticket and completes the ``_Pending`` futures in launch order.
+
+Because overlapped dispatches read a matrix that plans committed during
+their flight may mutate, each ticket records ``matrix.version`` at launch;
+a version mismatch at resolve time counts into ``stale_dispatches``.
+Correctness does not depend on the count: stale-read placements are
+re-checked by the serialized plan applier's authoritative re-verify
+(server/plan_apply.py ``_evaluate``) exactly as optimistic-worker plans
+already are.
 
 Shape discipline (SURVEY.md §7 hard-part e — p99 means no recompiles):
 every dispatch uses the SAME static shapes — ``max_lanes`` lanes (short
-batches padded with inert requests) and a ``PLACEMENT_CHUNK``-long scan
-(callers take the first rows they asked for) — so exactly one executable
-serves every batch size. Wasted lanes cost ~µs of MXU time; a recompile
-costs tens of seconds.
+batches padded by memset of the preallocated staging buffers) and a
+``PLACEMENT_CHUNK``-long scan (callers take the first rows they asked
+for) — so exactly one executable serves every batch size. Wasted lanes
+cost ~µs of MXU time; a recompile costs tens of seconds.
 
 The reference's analog: many schedulers walk nodes concurrently and the
 plan applier serializes commits (worker.go:49-53, plan_apply.go:49-69).
@@ -31,9 +49,12 @@ pick conflicting nodes; the applier's re-verify catches it.
 from __future__ import annotations
 
 import logging
+import os
+import queue
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -46,6 +67,17 @@ log = logging.getLogger(__name__)
 # Sparse plan-delta capacity per request; selects with more touched rows
 # fall back to the solo dispatch path.
 MAX_DELTA_ROWS = 32
+
+_DEPTH_ENV = "NOMAD_TPU_PIPELINE_DEPTH"
+
+
+def default_pipeline_depth() -> int:
+    """Overlapping dispatches kept in flight (env-tunable, default 8 — the
+    depth bench.py's pipelined phase showed amortizing the tunnel RTT)."""
+    try:
+        return max(1, int(os.environ.get(_DEPTH_ENV, "8")))
+    except ValueError:
+        return 8
 
 
 @dataclass
@@ -83,9 +115,20 @@ class _Pending:
     # The jax kernel ignores it (static shapes); the fake-device twin stops
     # its scan after this many live steps.
     n_live: int = 0
+    enqueued_at: float = 0.0
     done: threading.Event = field(default_factory=threading.Event)
     outcome: Optional[PlaceOutcome] = None
     error: Optional[BaseException] = None
+
+
+@dataclass
+class _Ticket:
+    """One in-flight dispatch: the un-fetched packed result, its lanes, and
+    the matrix version its inputs were synced at."""
+
+    packed: object
+    entries: List[_Pending]
+    matrix_version: int
 
 
 class DeviceCoalescer:
@@ -97,8 +140,9 @@ class DeviceCoalescer:
         max_lanes: int = 64,
         scan_length: Optional[int] = None,
         linger_s: float = 0.002,
-        max_inflight: int = 4,
+        pipeline_depth: Optional[int] = None,
         n_device_shards: Optional[int] = None,
+        metrics=None,
     ):
         from .stack import PLACEMENT_CHUNK
 
@@ -106,7 +150,9 @@ class DeviceCoalescer:
         self.max_lanes = max_lanes
         self.scan_length = scan_length or PLACEMENT_CHUNK
         self.linger_s = linger_s
-        self.max_inflight = max_inflight
+        self.pipeline_depth = (
+            pipeline_depth if pipeline_depth else default_pipeline_depth()
+        )
         # Multi-chip: when >1, dispatches go through the SPMD twin of
         # place_batch (parallel/sharding.py sharded_place_batch) over a
         # ('batch', 'node') mesh — the live server path the dryrun
@@ -114,20 +160,33 @@ class DeviceCoalescer:
         # accelerators, single-device on CPU (the virtual 8-CPU rig is a
         # test harness, not a deployment; tests opt in explicitly).
         self.n_device_shards = n_device_shards
+        self.metrics = metrics  # optional MetricsRegistry (the server's)
         self._mesh = None
         self._sharded_fn = None
         self._queue: List[_Pending] = []
         # Arbitrary device closures (system feasibility, bulk plan verify,
         # oversized-delta solo selects) executed on the dispatch thread so
-        # the live server has exactly ONE device-touching thread — the
+        # the live server has exactly ONE device-LAUNCHING thread — the
         # single-chip tunnel client wedges under concurrent host threads
-        # (state/matrix.py DEVICE_LOCK note).
+        # (state/matrix.py DEVICE_LOCK note).  The resolver thread only
+        # fetches already-launched results, the same overlap bench.py's
+        # pipelined phase exercises through the tunnel.
         self._ops: List["_DeviceOp"] = []
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._resolver: Optional[threading.Thread] = None
+        self._tickets: Optional["queue.Queue"] = None
+        self._depth_sem: Optional[threading.Semaphore] = None
+        # Preallocated (max_lanes, N) host staging buffers the lanes write
+        # into — per-dispatch np.stack allocations replaced by row writes,
+        # lane padding by memset (see _staging).
+        self._stage: Optional[Dict[str, np.ndarray]] = None
+        # Gauges/counters (ints under the GIL; exact enough for telemetry).
         self.dispatches = 0
         self.coalesced_requests = 0
+        self.stale_dispatches = 0
+        self.inflight = 0
 
     # ------------------------------------------------------------------
 
@@ -135,6 +194,16 @@ class DeviceCoalescer:
         if self._thread is not None and self._thread.is_alive():
             return  # leadership can cycle; one dispatch thread only
         self._stop.clear()
+        # The pipeline bound: a launch consumes a permit, the resolver
+        # returns it after the fetch, so exactly pipeline_depth dispatches
+        # overlap (depth 1 = the old serial behavior).  The ticket queue
+        # itself never blocks — its occupancy is bounded by the permits.
+        self._depth_sem = threading.BoundedSemaphore(self.pipeline_depth)
+        self._tickets = queue.Queue()
+        self._resolver = threading.Thread(
+            target=self._resolve_loop, name="resolver-coalescer", daemon=True
+        )
+        self._resolver.start()
         self._thread = threading.Thread(
             target=self._run, name="device-coalescer", daemon=True
         )
@@ -146,6 +215,10 @@ class DeviceCoalescer:
             self._cond.notify_all()
         if self._thread:
             self._thread.join(timeout=10)
+
+    def inflight_depth(self) -> int:
+        """Dispatches launched but not yet resolved (pipeline occupancy)."""
+        return self.inflight
 
     # ------------------------------------------------------------------
 
@@ -174,6 +247,7 @@ class DeviceCoalescer:
             class_elig=class_elig,
             host_mask=host_mask,
             n_live=n_live,
+            enqueued_at=time.time(),
         )
         with self._cond:
             if self._stop.is_set():
@@ -209,38 +283,72 @@ class DeviceCoalescer:
     # ------------------------------------------------------------------
 
     def _run(self) -> None:
-        inflight: List[Tuple[object, List[_Pending]]] = []
+        """Dispatch (producer) loop: build batches, launch, hand tickets to
+        the resolver.  Never blocks on a device→host fetch."""
+        from ..chaos import inject
+
         while True:
             self._drain_ops()
-            batch = self._next_batch(block=not inflight)
-            if batch is None and self._stop.is_set() and not inflight:
-                with self._cond:
-                    leftover_ops, self._ops = self._ops, []
-                    leftover_q, self._queue = self._queue, []
-                err = RuntimeError("coalescer stopped")
-                for op in leftover_ops:
-                    op.error = err
-                    op.done.set()
-                for p in leftover_q:
-                    p.error = err
-                    p.done.set()
+            batch = self._next_batch()
+            if batch is None and self._stop.is_set():
+                self._shutdown_pipeline()
                 return
-            if batch:
-                try:
-                    out = self._dispatch(batch)
-                    inflight.append((out, batch))
-                    self.dispatches += 1
-                    self.coalesced_requests += len(batch)
-                except BaseException as exc:  # noqa: BLE001
-                    for p in batch:
-                        p.error = exc
-                        p.done.set()
-            # Fetch the oldest dispatch when the pipe is full or there is
-            # nothing new to issue — keeps up to max_inflight overlapping
-            # the tunnel round-trip.
-            if inflight and (len(inflight) >= self.max_inflight or not batch):
-                out, entries = inflight.pop(0)
-                self._resolve(out, entries)
+            if not batch:
+                continue
+            inject("coalescer.dispatch", lanes=len(batch))
+            # Wait for a pipeline slot BEFORE launching: the permit bounds
+            # overlapping latency windows (and how stale an in-flight read
+            # can get).  Requests arriving during the wait coalesce into
+            # the NEXT batch — the batch itself is already sealed.
+            self._depth_sem.acquire()
+            if self.metrics is not None:
+                waited = time.time()
+                qw = self.metrics.timer("nomad.coalescer.queue_wait")
+                for p in batch:
+                    qw.observe(max(0.0, waited - p.enqueued_at))
+            try:
+                packed, version = self._dispatch(batch)
+            except BaseException as exc:  # noqa: BLE001
+                self._depth_sem.release()
+                for p in batch:
+                    p.error = exc
+                    p.done.set()
+                continue
+            self.dispatches += 1
+            self.coalesced_requests += len(batch)
+            self.inflight += 1
+            self._tickets.put(_Ticket(packed, batch, version))
+
+    def _shutdown_pipeline(self) -> None:
+        """Stop path: fail queued work, let the resolver drain in-flight
+        tickets (their callers are still blocked on real futures), then
+        join it."""
+        with self._cond:
+            leftover_ops, self._ops = self._ops, []
+            leftover_q, self._queue = self._queue, []
+        err = RuntimeError("coalescer stopped")
+        for op in leftover_ops:
+            op.error = err
+            op.done.set()
+        for p in leftover_q:
+            p.error = err
+            p.done.set()
+        self._tickets.put(None)  # sentinel after every real ticket
+        self._resolver.join(timeout=10)
+
+    def _resolve_loop(self) -> None:
+        """Resolver (consumer) loop: the ONLY place the live path blocks on
+        a device→host fetch.  Tickets complete in launch order."""
+        while True:
+            ticket = self._tickets.get()
+            if ticket is None:
+                return
+            self._resolve(ticket)
+            self.inflight -= 1
+            self._depth_sem.release()
+            with self._cond:
+                # Wake an idle dispatch loop waiting to quiesce.
+                self._cond.notify_all()
 
     def _drain_ops(self) -> None:
         while True:
@@ -254,12 +362,20 @@ class DeviceCoalescer:
                 op.error = exc
             op.done.set()
 
-    def _next_batch(self, block: bool) -> Optional[List[_Pending]]:
+    def _next_batch(self) -> Optional[List[_Pending]]:
         with self._cond:
-            if not self._queue and block:
+            if not self._queue:
+                # Idle = wait on the condvar until work or stop arrives (the
+                # drainer's PR-2 fix applied here: no 0.2s wakeup when fully
+                # idle).  While dispatches are in flight keep a bounded wait
+                # so the loop re-checks pipeline state even if a notify is
+                # lost to a crashed resolver.
+                timeout = 0.2 if self.inflight else None
                 self._cond.wait_for(
-                    lambda: self._queue or self._ops or self._stop.is_set(),
-                    timeout=0.2,
+                    lambda: bool(self._queue)
+                    or bool(self._ops)
+                    or self._stop.is_set(),
+                    timeout=timeout,
                 )
             if not self._queue:
                 return None
@@ -301,7 +417,35 @@ class DeviceCoalescer:
             )
         return self.n_device_shards
 
+    def _staging(self, n: int, cw: int, sc_shape) -> Dict[str, np.ndarray]:
+        """Preallocated (max_lanes, …) host staging buffers.  Lanes write
+        rows in place; unused lanes are padded by memset — no per-dispatch
+        np.stack allocations, no filler _Pending objects.  Rebuilt only
+        when the matrix grows or the class-pad bucket shifts."""
+        st = self._stage
+        if (
+            st is None
+            or st["host_mask"].shape[1] != n
+            or st["class_elig"].shape[1] != cw
+            or st["spread_counts"].shape[1:] != sc_shape
+        ):
+            lanes = self.max_lanes
+            st = self._stage = {
+                "host_mask": np.zeros((lanes, n), bool),
+                "tg_count": np.zeros((lanes, n), np.int32),
+                "penalty": np.zeros((lanes, n), bool),
+                "class_elig": np.ones((lanes, cw), bool),
+                "spread_counts": np.zeros((lanes,) + sc_shape, np.float32),
+                "delta_rows": np.full((lanes, MAX_DELTA_ROWS), -1, np.int32),
+                "delta_vals": np.zeros(
+                    (lanes, MAX_DELTA_ROWS, 3), np.float32
+                ),
+            }
+        return st
+
     def _dispatch(self, batch: List[_Pending]):
+        """Launch one batched place_batch; returns (unfetched packed result,
+        matrix version at launch)."""
         from ..ops import fake_device
 
         fake = fake_device.enabled()
@@ -309,43 +453,47 @@ class DeviceCoalescer:
             n_shards = 1
         else:
             n_shards = self._resolve_sharding()
-        with DEVICE_LOCK:
-            arrays = self.matrix.sync()
-        n = int(arrays.used.shape[0])
 
-        # Requests built just before a matrix growth or a class-count pow2
-        # crossing carry narrower arrays; pad each by its OWN width
-        # (new rows masked off — they were not host-checked; unknown
-        # classes eligible, matching _class_eligibility's default).
-        for p in batch:
-            if p.host_mask.shape[0] < n:
-                p.host_mask = np.concatenate([
-                    p.host_mask,
-                    np.zeros((n - p.host_mask.shape[0],), bool),
-                ])
-            if p.tg_count.shape[0] < n:
-                p.tg_count = np.concatenate([
-                    p.tg_count,
-                    np.zeros((n - p.tg_count.shape[0],), np.int32),
-                ])
-            if p.penalty.shape[0] < n:
-                p.penalty = np.concatenate([
-                    p.penalty,
-                    np.zeros((n - p.penalty.shape[0],), bool),
-                ])
-        cw = max(p.class_elig.shape[0] for p in batch)
-        for p in batch:
-            if p.class_elig.shape[0] < cw:
-                p.class_elig = np.concatenate([
-                    p.class_elig,
-                    np.ones((cw - p.class_elig.shape[0],), bool),
-                ])
+        sharded = None
+        if n_shards > 1:
+            # Multi-chip: the matrix stays RESIDENT across the mesh —
+            # sync_sharded scatters only dirty rows to the owning shard
+            # instead of re-laying the full matrix per dispatch.
+            with DEVICE_LOCK:
+                sharded = self.matrix.sync_sharded(self._mesh)
+                version = self.matrix.version
+            n = int(self.matrix.capacity)
+            arrays = None
+        else:
+            with DEVICE_LOCK:
+                arrays = self.matrix.sync()
+                version = self.matrix.version
+            n = int(arrays.used.shape[0])
 
         if fake:
             # Fake-device backend: numpy twins answer synchronously from
             # the host snapshot.  No lane padding (shapes need not be
             # static for numpy) and no stacking — the twin takes lists.
-            return fake_device.place_batch(
+            # Requests built just before a matrix growth carry narrower
+            # arrays; pad each by its OWN width (new rows masked off —
+            # they were not host-checked).
+            for p in batch:
+                if p.host_mask.shape[0] < n:
+                    p.host_mask = np.concatenate([
+                        p.host_mask,
+                        np.zeros((n - p.host_mask.shape[0],), bool),
+                    ])
+                if p.tg_count.shape[0] < n:
+                    p.tg_count = np.concatenate([
+                        p.tg_count,
+                        np.zeros((n - p.tg_count.shape[0],), np.int32),
+                    ])
+                if p.penalty.shape[0] < n:
+                    p.penalty = np.concatenate([
+                        p.penalty,
+                        np.zeros((n - p.penalty.shape[0],), bool),
+                    ])
+            packed = fake_device.place_batch(
                 arrays,
                 arrays.used,
                 [p.delta_rows for p in batch],
@@ -359,63 +507,94 @@ class DeviceCoalescer:
                 n_placements=self.scan_length,
                 live_counts=[p.n_live or self.scan_length for p in batch],
             )
+            lat = fake_device.latency_s()
+            if lat > 0:
+                # Synthetic tunnel RTT: the fetch pays it, not the launch,
+                # so overlapping dispatches overlap their latency windows.
+                packed = fake_device.DeferredResult(packed, lat)
+            return packed, version
 
         import jax
 
-        # Pad to the fixed lane count with inert copies of the first
-        # request (host_mask all-False → every placement fails cheaply).
-        lanes: List[_Pending] = list(batch)
-        if len(lanes) < self.max_lanes:
-            inert = batch[0]
-            dead_mask = np.zeros_like(inert.host_mask)
-            filler = _Pending(
-                request=inert.request,
-                delta_rows=np.full_like(inert.delta_rows, -1),
-                delta_vals=np.zeros_like(inert.delta_vals),
-                tg_count=inert.tg_count,
-                spread_counts=inert.spread_counts,
-                penalty=inert.penalty,
-                class_elig=inert.class_elig,
-                host_mask=dead_mask,
-            )
-            lanes.extend([filler] * (self.max_lanes - len(lanes)))
+        k = len(batch)
+        cw = max(p.class_elig.shape[0] for p in batch)
+        sc_shape = batch[0].spread_counts.shape
+        st = self._staging(n, cw, sc_shape)
+        hm, tg = st["host_mask"], st["tg_count"]
+        pen, ce = st["penalty"], st["class_elig"]
+        sc, dr, dv = st["spread_counts"], st["delta_rows"], st["delta_vals"]
+        for i, p in enumerate(batch):
+            # Requests built just before a matrix growth or a class-count
+            # pow2 crossing carry narrower arrays; the staging row's tail
+            # keeps the inert value (new rows masked off — they were not
+            # host-checked; unknown classes eligible, matching
+            # _class_eligibility's default).
+            w = p.host_mask.shape[0]
+            hm[i, :w] = p.host_mask
+            hm[i, w:] = False
+            w = p.tg_count.shape[0]
+            tg[i, :w] = p.tg_count
+            tg[i, w:] = 0
+            w = p.penalty.shape[0]
+            pen[i, :w] = p.penalty
+            pen[i, w:] = False
+            w = p.class_elig.shape[0]
+            ce[i, :w] = p.class_elig
+            ce[i, w:] = True
+            sc[i] = p.spread_counts
+            dr[i] = p.delta_rows
+            dv[i] = p.delta_vals
+        if k < self.max_lanes:
+            # Pad lanes by memset: an all-False host mask makes every
+            # placement in the lane fail cheaply; whatever the other
+            # staging rows still hold from earlier dispatches only affects
+            # the dead lane's own (discarded) scores.  Deltas are reset so
+            # a stale row id can't scatter into the shared used base.
+            hm[k:] = False
+            dr[k:] = -1
 
+        # Request pytrees still stack per dispatch (small per-predicate
+        # arrays); dead lanes reuse lane 0's request.
+        req_lanes = [p.request for p in batch]
+        if k < self.max_lanes:
+            req_lanes.extend([batch[0].request] * (self.max_lanes - k))
         reqs = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs), *[p.request for p in lanes]
-        )
-        args = (
-            arrays,
-            arrays.used,
-            np.stack([p.delta_rows for p in lanes]),
-            np.stack([p.delta_vals for p in lanes]),
-            np.stack([p.tg_count for p in lanes]),
-            np.stack([p.spread_counts for p in lanes]),
-            np.stack([p.penalty for p in lanes]),
-            reqs,
-            np.stack([p.class_elig for p in lanes]),
-            np.stack([p.host_mask for p in lanes]),
+            lambda *xs: np.stack(xs), *req_lanes
         )
         if n_shards > 1:
-            from ..parallel.sharding import shard_matrix_arrays
-
-            # Lay the matrix across the mesh's node axis.  (Sharded-
-            # resident incremental updates are a further optimization;
-            # today the authoritative copy lives on device 0 and re-lays
-            # per dispatch.)
-            sharded = shard_matrix_arrays(self._mesh, arrays)
             return self._sharded_fn(
-                sharded, sharded.used, *args[2:]
-            )
-        return kernels.place_batch(*args, n_placements=self.scan_length)
+                sharded, sharded.used, dr, dv, tg, sc, pen, reqs, ce, hm
+            ), version
+        # place_batch_live donates the per-dispatch lane operands (their
+        # device buffers become XLA scratch); `arrays`/`used` stay live —
+        # they are matrix-resident and shared with in-flight dispatches.
+        return kernels.place_batch_live(
+            arrays, arrays.used, dr, dv, tg, sc, pen, reqs, ce, hm,
+            n_placements=self.scan_length,
+        ), version
 
-    def _resolve(self, packed, entries: List[_Pending]) -> None:
+    def _resolve(self, ticket: _Ticket) -> None:
+        from ..ops.fake_device import DeferredResult
+
+        packed, entries = ticket.packed, ticket.entries
         try:
+            if isinstance(packed, DeferredResult):
+                packed = packed.result()
             arr = np.asarray(packed)  # ONE device→host fetch per dispatch
         except BaseException as exc:  # noqa: BLE001
             for p in entries:
                 p.error = exc
                 p.done.set()
             return
+        if self.matrix.version != ticket.matrix_version:
+            # The matrix moved while this dispatch was in flight: its
+            # placements were scored against a stale snapshot.  They are
+            # still safe to propose — the serialized applier re-verifies
+            # every plan against authoritative state — but the count is
+            # the pipelining tax worth watching.
+            self.stale_dispatches += 1
+            if self.metrics is not None:
+                self.metrics.incr("nomad.coalescer.stale_dispatches")
         for i, p in enumerate(entries):
             row = arr[i]
             p.outcome = PlaceOutcome(

@@ -70,6 +70,9 @@ class ServerConfig:
     core_gc_interval: float = 300.0
     # Max selects batched into one device dispatch (scheduler/coalescer.py).
     coalescer_lanes: int = 64
+    # Overlapping dispatches the coalescer keeps in flight (pipelined
+    # producer/consumer loop). None = env NOMAD_TPU_PIPELINE_DEPTH, default 8.
+    pipeline_depth: Optional[int] = None
     # Devices the coalescer shards dispatches over (parallel/sharding.py).
     # None = auto: every visible chip on real accelerators, 1 on CPU.
     n_device_shards: Optional[int] = None
@@ -141,7 +144,9 @@ class Server:
 
         self.coalescer = DeviceCoalescer(
             self.matrix, max_lanes=self.config.coalescer_lanes,
+            pipeline_depth=self.config.pipeline_depth,
             n_device_shards=self.config.n_device_shards,
+            metrics=self.metrics,
         )
         self.matrix.coalescer = self.coalescer
 

@@ -287,6 +287,25 @@ class NodeMatrix:
         self._dirty: set = set()
         self._device: Optional[DeviceArrays] = None
         self._device_valid = False
+        # Monotonic mutation counter, bumped on every host-side row change.
+        # Pipelined dispatches record it at launch; a mismatch at resolve
+        # time means the dispatch scored a stale snapshot (counted by the
+        # coalescer — the applier's re-verify is the correctness backstop).
+        self.version = 0
+        # Transfer telemetry (exported via /v1/metrics): proves steady-state
+        # syncs move O(dirty rows), not the whole matrix.
+        self.full_uploads = 0
+        self.scatter_syncs = 0
+        self.rows_scattered_total = 0
+        self.upload_bytes_total = 0
+        # Sharded residency (multi-chip dispatch path): a second device
+        # mirror laid out across a mesh, with its own dirty set so the
+        # single-device and sharded copies sync independently.
+        self._sharded_device: Optional[DeviceArrays] = None
+        self._sharded_valid = False
+        self._sharded_dirty: set = set()
+        self._sharded_mesh = None
+        self._sharded_scatter = None
         # Guards _alloc row writes + _dirty against the sync drain: store
         # mutators run under the store lock, sync under DEVICE_LOCK — with
         # no common lock, a row marked dirty while sync snapshots the set
@@ -366,6 +385,7 @@ class NodeMatrix:
         self._alloc = new
         self.capacity = new_cap
         self._device_valid = False
+        self._sharded_valid = False
 
     @property
     def n_rows(self) -> int:
@@ -388,6 +408,14 @@ class NodeMatrix:
 
     # -- mutations ----------------------------------------------------------
 
+    def _mark_dirty_locked(self, row: int) -> None:
+        """Record a row mutation (caller holds _host_lock): both device
+        mirrors resync it, and the version bump lets in-flight pipelined
+        dispatches detect they scored a stale snapshot."""
+        self._dirty.add(row)
+        self._sharded_dirty.add(row)
+        self.version += 1
+
     def clear(self) -> None:
         """Drop every row (snapshot install replaces all state). Registries
         persist — attribute slots are append-only by design."""
@@ -401,6 +429,9 @@ class NodeMatrix:
             self._alloc = self._allocate_arrays(self.capacity)
             self._dirty.clear()
             self._device_valid = False
+            self._sharded_dirty.clear()
+            self._sharded_valid = False
+            self.version += 1
 
     def upsert_node(self, node: Node) -> int:
         """Insert or refresh a node's static columns (totals, attrs, class).
@@ -455,7 +486,7 @@ class NodeMatrix:
             if 0 <= p < PORT_BITS:
                 a["port_words"][row, p >> 5] |= np.uint32(1 << (p & 31))
 
-        self._dirty.add(row)
+        self._mark_dirty_locked(row)
         return row
 
     def set_eligibility(self, node_id: str, eligible: bool) -> None:
@@ -464,7 +495,7 @@ class NodeMatrix:
             if row is None:
                 return
             self._alloc["eligible"][row] = eligible
-            self._dirty.add(row)
+            self._mark_dirty_locked(row)
 
     def remove_node(self, node_id: str) -> None:
         with self._host_lock:
@@ -496,7 +527,7 @@ class NodeMatrix:
         self._alloc["class_id"][row] = -1
         self._alloc["prio_used"][row] = 0
         self._free.append(row)
-        self._dirty.add(row)
+        self._mark_dirty_locked(row)
 
     def _usage_of(self, alloc: Allocation) -> np.ndarray:
         r = alloc.resources
@@ -554,7 +585,7 @@ class NodeMatrix:
             if slot is not None:
                 self._alloc["dev_used"][row, slot] += dev.count
         self._port_delta(row, alloc, claim=True)
-        self._dirty.add(row)
+        self._mark_dirty_locked(row)
 
     def _remove_alloc_locked(self, alloc: Allocation) -> None:
         row = self.row_of.get(alloc.node_id)
@@ -573,7 +604,7 @@ class NodeMatrix:
                     0, self._alloc["dev_used"][row, slot] - dev.count
                 )
         self._port_delta(row, alloc, claim=False)
-        self._dirty.add(row)
+        self._mark_dirty_locked(row)
 
     # -- device sync --------------------------------------------------------
 
@@ -630,13 +661,18 @@ class NodeMatrix:
                 # the transfer would clobber that invalidation and leave
                 # post-growth rows silently out of device bounds.
                 self._device_valid = True
+            self.full_uploads += 1
             if fake:
                 # Fake-device backend: the "device snapshot" is the host
                 # copy itself; dispatches consume it synchronously on the
                 # coalescer thread before the next sync can scatter into
-                # it, so no further copies are needed.
+                # it, so no further copies are needed.  (No transfer, so
+                # upload_bytes_total doesn't move.)
                 self._device = DeviceArrays(**host_copy)
                 return self._device
+            self.upload_bytes_total += sum(
+                a.nbytes for a in host_copy.values()
+            )
             try:
                 import jax
 
@@ -662,6 +698,8 @@ class NodeMatrix:
                 # O(dirty rows) incremental cost as the device path).
                 for f in DeviceArrays._fields:
                     getattr(self._device, f)[rows] = self._alloc[f][rows]
+                self.scatter_syncs += 1
+                self.rows_scattered_total += len(rows)
                 return self._device
             # Pad the row count to a pow2 bucket (repeating row 0 — the
             # duplicate scatter writes identical data) so the jitted
@@ -680,7 +718,85 @@ class NodeMatrix:
             with self._host_lock:
                 self._dirty.update(int(r) for r in rows)
             raise
+        self.scatter_syncs += 1
+        self.rows_scattered_total += k
+        self.upload_bytes_total += sum(a.nbytes for a in row_data)
         return self._device
 
     def invalidate(self) -> None:
         self._device_valid = False
+        self._sharded_valid = False
+
+    # -- sharded device sync ------------------------------------------------
+
+    def sync_sharded(self, mesh) -> DeviceArrays:
+        """Return the mesh-resident snapshot for multi-chip dispatch,
+        scattering only dirty rows to their owning shard.
+
+        The sharded mirror used to be re-laid in full (shard_matrix_arrays
+        over the whole host matrix) before EVERY dispatch; now it stays
+        resident across dispatches exactly like the single-device copy —
+        full lay-out on first use/growth/mesh change, O(dirty rows)
+        scatter otherwise (the jitted scatter is sharding-aware: each row
+        lands on the shard that owns it).
+        """
+        with DEVICE_LOCK:
+            return self._sync_sharded_locked(mesh)
+
+    def _sync_sharded_locked(self, mesh) -> DeviceArrays:
+        from ..parallel.sharding import (
+            make_sharded_row_scatter,
+            shard_matrix_arrays,
+        )
+
+        if self._sharded_mesh is not mesh:
+            self._sharded_mesh = mesh
+            self._sharded_scatter = make_sharded_row_scatter(mesh)
+            self._sharded_valid = False
+
+        if self._sharded_device is None or not self._sharded_valid:
+            with self._host_lock:
+                host_copy = {
+                    f: self._alloc[f].copy() for f in DeviceArrays._fields
+                }
+                self._sharded_dirty.clear()
+                # Same ordering contract as _sync_locked: claim validity
+                # under the lock so a concurrent _grow's invalidation wins.
+                self._sharded_valid = True
+            try:
+                self._sharded_device = shard_matrix_arrays(
+                    mesh, DeviceArrays(**host_copy)
+                )
+            except BaseException:
+                self._sharded_valid = False
+                raise
+            self.full_uploads += 1
+            self.upload_bytes_total += sum(
+                a.nbytes for a in host_copy.values()
+            )
+            return self._sharded_device
+
+        with self._host_lock:
+            if not self._sharded_dirty:
+                return self._sharded_device
+            rows = np.fromiter(self._sharded_dirty, np.int32)
+            self._sharded_dirty.clear()
+            # Pow2 row-count buckets, as in _sync_locked, so the sharded
+            # scatter compiles once per bucket.
+            k = len(rows)
+            padded = 1 << max(0, (k - 1)).bit_length()
+            idx = np.full((padded,), rows[0], np.int32)
+            idx[:k] = rows
+            row_data = [self._alloc[f][idx] for f in DeviceArrays._fields]
+        try:
+            self._sharded_device = self._sharded_scatter(
+                self._sharded_device, idx, *row_data
+            )
+        except BaseException:
+            with self._host_lock:
+                self._sharded_dirty.update(int(r) for r in rows)
+            raise
+        self.scatter_syncs += 1
+        self.rows_scattered_total += k
+        self.upload_bytes_total += sum(a.nbytes for a in row_data)
+        return self._sharded_device

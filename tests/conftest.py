@@ -49,6 +49,12 @@ def pytest_configure(config):
         "markers",
         "slow: exhaustive chaos sweeps excluded from tier-1 (-m 'not slow')",
     )
+    # place_batch_live donates its lane operands; CPU XLA doesn't implement
+    # donation and warns per compile.  Real accelerators honor it silently.
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable:UserWarning",
+    )
 
 # Kernel first-compiles are tens of seconds; persist them across test runs.
 nomad_tpu.enable_compilation_cache("/root/repo/.jax_cache")

@@ -9,6 +9,12 @@ run.  On the first bench that reports ``platform != cpu`` the raw JSON is
 written to ``BENCH_tpu_evidence.json`` at the repo root — the artifact
 PARITY.md's ≥50K claim is waiting on.
 
+The bench it launches runs every phase of ``bench.py`` main(), which
+since round 6 includes the ``live_pipeline`` depth sweep (pipelined
+coalescer under synthetic fetch latency, ``BENCH_LIVE_*`` knobs) — a
+TPU evidence artifact therefore also carries the live-path pipelining
+numbers alongside the kernel throughput.
+
 Usage:
     python tools/bench_watch.py [--attempts N] [--interval S] [--once]
 

@@ -8,11 +8,19 @@ worker/applier/coalescer threads).  On the CPU backend: the question is
 where HOST time goes, not device time.
 
 Usage: JAX_PLATFORMS=cpu python tools/profile_host_loop.py [jobs] [nodes]
-Writes tools/host_loop_profile.txt.
+           [--latency-ms MS] [--out PATH]
+Writes tools/host_loop_profile.txt (override with --out).
+
+``--latency-ms`` turns on the fake-device backend with a synthetic
+device→host fetch latency (NOMAD_TPU_FAKE_DEVICE_LATENCY_MS) — the knob
+that makes the coalescer's dispatch/resolve overlap visible on a CPU-only
+box: with the latency charged at resolve time, a profile shows exactly
+which thread eats the tunnel RTT.
 """
 
 from __future__ import annotations
 
+import argparse
 import collections
 import os
 import sys
@@ -32,8 +40,26 @@ _scrub_non_cpu_backends()
 
 import numpy as np  # noqa: E402
 
-N_JOBS = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-N_NODES = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+_ap = argparse.ArgumentParser(description="host-loop sampling profiler")
+_ap.add_argument("jobs", nargs="?", type=int, default=256)
+_ap.add_argument("nodes", nargs="?", type=int, default=2000)
+_ap.add_argument(
+    "--latency-ms", type=float, default=None,
+    help="fake-device synthetic fetch latency; implies NOMAD_TPU_FAKE_DEVICE=1",
+)
+_ap.add_argument(
+    "--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "host_loop_profile.txt"
+    ),
+    help="report path (default tools/host_loop_profile.txt)",
+)
+_ARGS = _ap.parse_args()
+
+N_JOBS = _ARGS.jobs
+N_NODES = _ARGS.nodes
+if _ARGS.latency_ms is not None:
+    os.environ["NOMAD_TPU_FAKE_DEVICE"] = "1"
+    os.environ["NOMAD_TPU_FAKE_DEVICE_LATENCY_MS"] = str(_ARGS.latency_ms)
 WORKERS = int(os.environ.get("PROFILE_WORKERS", "8"))
 # Modest rate + raw-frame walking: traceback.extract_stack at high Hz
 # reads source through linecache and hogs the GIL hard enough to starve
@@ -155,10 +181,11 @@ def main() -> None:
     sampler.stop()
     rate = (N_JOBS - len(pending)) / wall
 
+    lat = os.environ.get("NOMAD_TPU_FAKE_DEVICE_LATENCY_MS", "0")
     lines = [
         f"e2e host profile: {N_JOBS} jobs, {N_NODES} nodes, "
-        f"{WORKERS} workers -> {rate:.1f} evals/s wall={wall:.1f}s "
-        f"(pending={len(pending)})",
+        f"{WORKERS} workers, latency={lat}ms -> {rate:.1f} evals/s "
+        f"wall={wall:.1f}s (pending={len(pending)})",
         f"coalescer: dispatches={srv.coalescer.dispatches} "
         f"coalesced={srv.coalescer.coalesced_requests}",
         f"samples: {sampler.samples} @ {SAMPLE_HZ:.0f}Hz "
@@ -175,8 +202,7 @@ def main() -> None:
     srv.shutdown()
 
     report = "\n".join(lines) + "\n"
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "host_loop_profile.txt")
+    path = _ARGS.out
     with open(path, "w") as fh:
         fh.write(report)
     print(report[:3000])
