@@ -664,6 +664,17 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    from .lint.__main__ import main as lint_main
+
+    forwarded = []
+    if args.verbose:
+        forwarded.append("--verbose")
+    if args.baseline:
+        forwarded += ["--baseline", args.baseline]
+    return lint_main(forwarded)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="nomad-tpu", description="TPU-native workload orchestrator"
@@ -908,6 +919,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     metrics = sub.add_parser("metrics", help="agent metrics")
     metrics.set_defaults(fn=cmd_metrics)
+
+    lint = sub.add_parser(
+        "lint", help="static analysis: lock discipline, JAX hot path, chaos seams"
+    )
+    lint.add_argument("-v", "--verbose", action="store_true")
+    lint.add_argument("--baseline", default=None)
+    lint.set_defaults(fn=cmd_lint)
     return p
 
 

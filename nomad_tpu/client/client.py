@@ -511,8 +511,11 @@ class Client:
         client.go:2363)."""
         while not self._shutdown.is_set():
             with self._dirty_cond:
+                # Untimed: shutdown() and _alloc_updated() both notify
+                # under _dirty_cond, so every predicate edge has a wake-up
+                # (lint rule L004 — no polling around a lost notify).
                 self._dirty_cond.wait_for(
-                    lambda: self._dirty or self._shutdown.is_set(), timeout=1.0
+                    lambda: self._dirty or self._shutdown.is_set()
                 )
                 if self._shutdown.is_set():
                     return

@@ -1,0 +1,140 @@
+"""``nomad lint`` — static analysis for the concurrency + JAX hot paths.
+
+Three AST passes over the production tree, one runtime sanitizer:
+
+* **lock discipline** (:mod:`.lockpass`, rules ``L001``–``L004``) —
+  per-function lock-acquisition graphs across ``server/``,
+  ``scheduler/``, ``state/``, ``client/``, ``stream/``, checked against
+  the declared hierarchy in :mod:`.lock_order`.
+* **JAX hot path** (:mod:`.jaxpass`, rules ``J001``–``J003``) — implicit
+  host syncs on device values, jit-captured mutable globals, and
+  non-hashable static args in ``ops/``, ``parallel/``,
+  ``scheduler/coalescer.py``, ``state/matrix.py``.
+* **chaos seams** (:mod:`.chaospass`, rules ``C001``–``C004``) — the
+  CHAOS.md seam catalog and retry surface cross-checked against the
+  injector call sites and the tests that exercise them.
+* **TSan-lite** (:mod:`.tsan`) — the runtime half: lockset-checked
+  shared-state wrappers enabled under the seeded chaos scenarios.
+
+Findings carry ``rule``, ``path:line`` and the enclosing ``symbol``;
+``baseline.json`` allowlists deliberate exemptions by
+``(rule, path, symbol)`` so the gate starts green and ratchets — see
+STATIC_ANALYSIS.md for the workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "repo_root",
+    "run_all",
+    "load_baseline",
+    "split_baselined",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit.  ``symbol`` is the enclosing function/method
+    qualname (``Class.method`` or ``<module>``) — baseline matching keys
+    on it instead of the line number so ordinary edits don't churn the
+    allowlist."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.symbol}] {self.message}"
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """The repository root: the nearest ancestor of this package that
+    contains the ``nomad_tpu`` directory itself."""
+    here = start or os.path.dirname(os.path.abspath(__file__))
+    d = here
+    while True:
+        if os.path.isdir(os.path.join(d, "nomad_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:  # filesystem root — fall back to cwd
+            return os.getcwd()
+        d = parent
+
+
+def run_all(root: Optional[str] = None) -> List[Finding]:
+    """Run every pass over the repo; returns findings sorted by path/line."""
+    from . import chaospass, jaxpass, lockpass
+
+    root = root or repo_root()
+    findings: List[Finding] = []
+    findings += lockpass.run(root)
+    findings += jaxpass.run(root)
+    findings += chaospass.run(root)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# Baseline (the ratchet)
+# ----------------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+@dataclass
+class Baseline:
+    """The committed allowlist: entries are ``{rule, path, symbol, why}``.
+    ``used`` tracks which entries matched this run so ``--prune`` can
+    report stale ones."""
+
+    entries: List[Dict[str, str]] = field(default_factory=list)
+
+    def match(self, f: Finding) -> Optional[Dict[str, str]]:
+        for e in self.entries:
+            if (
+                e.get("rule") == f.rule
+                and e.get("path") == f.path
+                and e.get("symbol") == f.symbol
+            ):
+                return e
+        return None
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    p = path or BASELINE_PATH
+    if not os.path.exists(p):
+        return Baseline()
+    with open(p) as fh:
+        data = json.load(fh)
+    return Baseline(entries=list(data.get("exemptions", [])))
+
+
+def split_baselined(
+    findings: Sequence[Finding], baseline: Baseline
+) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
+    """Partition findings into (new, suppressed) and report baseline
+    entries that matched nothing (stale — candidates for deletion)."""
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    matched: List[Dict[str, str]] = []
+    for f in findings:
+        e = baseline.match(f)
+        if e is None:
+            new.append(f)
+        else:
+            suppressed.append(f)
+            if e not in matched:
+                matched.append(e)
+    stale = [e for e in baseline.entries if e not in matched]
+    return new, suppressed, stale

@@ -44,6 +44,7 @@ from .encode import (
     OP_VER_LTE,
     SchedRequest,
 )
+from ..retry import env_float
 
 NEG_INF = -1e30
 PREEMPTION_RATE = 0.0048
@@ -69,11 +70,7 @@ def latency_s() -> float:
     in-flight dispatches overlap their latency windows exactly like real
     pipelined fetches, which is what makes pipeline speedup provable in CI
     without the (flaky) tunnel."""
-    try:
-        ms = float(os.environ.get(_LATENCY_ENV, "0") or "0")
-    except ValueError:
-        return 0.0
-    return max(0.0, ms) / 1000.0
+    return max(0.0, env_float(_LATENCY_ENV, 0.0)) / 1000.0
 
 
 class DeferredResult:

@@ -19,11 +19,41 @@ instead of N copies of ``while True: sleep``.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
+
+
+def env_int(name: str, default: int) -> int:
+    """Tolerant integer env knob: unset, empty, or unparsable → default.
+    The one parser for every ``NOMAD_TPU_*``/``BENCH_*`` tuning variable,
+    so tools and product code agree on the failure mode (a typo'd knob
+    degrades to the default instead of crashing an agent at import)."""
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Tolerant float env knob — see :func:`env_int`."""
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def env_defaults(**pairs: str) -> None:
+    """``os.environ.setdefault`` for several knobs at once — the shared
+    rig-setup helper for tools that must pin env before jax imports
+    (tools/chaos_repro.py; tests/conftest.py force-sets instead)."""
+    for name, value in pairs.items():
+        os.environ.setdefault(name, value)
 
 
 @dataclass(frozen=True)

@@ -60,6 +60,7 @@ import numpy as np
 
 from ..ops import kernels
 from ..ops.encode import SchedRequest
+from ..retry import env_int
 from ..state.matrix import DEVICE_LOCK
 
 log = logging.getLogger(__name__)
@@ -74,10 +75,7 @@ _DEPTH_ENV = "NOMAD_TPU_PIPELINE_DEPTH"
 def default_pipeline_depth() -> int:
     """Overlapping dispatches kept in flight (env-tunable, default 8 — the
     depth bench.py's pipelined phase showed amortizing the tunnel RTT)."""
-    try:
-        return max(1, int(os.environ.get(_DEPTH_ENV, "8")))
-    except ValueError:
-        return 8
+    return max(1, env_int(_DEPTH_ENV, 8))
 
 
 @dataclass
@@ -187,6 +185,11 @@ class DeviceCoalescer:
         self.coalesced_requests = 0
         self.stale_dispatches = 0
         self.inflight = 0
+        # TSan-lite (lint/tsan.py): lockset checking on the pending queue
+        # and device-op list when a test enabled the sanitizer.
+        from ..lint.tsan import maybe_instrument
+
+        maybe_instrument("coalescer", self)
 
     # ------------------------------------------------------------------
 
@@ -343,12 +346,24 @@ class DeviceCoalescer:
             ticket = self._tickets.get()
             if ticket is None:
                 return
-            self._resolve(ticket)
-            self.inflight -= 1
-            self._depth_sem.release()
-            with self._cond:
-                # Wake an idle dispatch loop waiting to quiesce.
-                self._cond.notify_all()
+            try:
+                self._resolve(ticket)
+            except BaseException as exc:  # noqa: BLE001
+                # _resolve guards the fetch itself; this catches anything
+                # after it (outcome unpack, metrics).  Fail the lanes and
+                # keep the resolver alive — pipeline accounting below must
+                # run no matter what, or the dispatch loop deadlocks on a
+                # permit that will never come back.
+                for p in ticket.entries:
+                    if not p.done.is_set():
+                        p.error = exc
+                        p.done.set()
+            finally:
+                self.inflight -= 1
+                self._depth_sem.release()
+                with self._cond:
+                    # Wake an idle dispatch loop waiting to quiesce.
+                    self._cond.notify_all()
 
     def _drain_ops(self) -> None:
         while True:
@@ -365,17 +380,16 @@ class DeviceCoalescer:
     def _next_batch(self) -> Optional[List[_Pending]]:
         with self._cond:
             if not self._queue:
-                # Idle = wait on the condvar until work or stop arrives (the
-                # drainer's PR-2 fix applied here: no 0.2s wakeup when fully
-                # idle).  While dispatches are in flight keep a bounded wait
-                # so the loop re-checks pipeline state even if a notify is
-                # lost to a crashed resolver.
-                timeout = 0.2 if self.inflight else None
+                # Untimed wait: every transition the predicate watches
+                # notifies _cond — place()/run_device_op() on enqueue,
+                # stop() on shutdown, and the resolver's try/finally
+                # guarantees its wake-up even when _resolve raises, so
+                # there is no lost-notify hole left to poll around
+                # (lint rule L004).
                 self._cond.wait_for(
                     lambda: bool(self._queue)
                     or bool(self._ops)
                     or self._stop.is_set(),
-                    timeout=timeout,
                 )
             if not self._queue:
                 return None

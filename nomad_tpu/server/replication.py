@@ -45,14 +45,14 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from ..chaos import inject
-from ..retry import Backoff, RetryPolicy
+from ..retry import Backoff, RetryPolicy, env_float
 
 log = logging.getLogger(__name__)
 
 # Operators (and the test suite) can widen every raft timer under CPU
 # contention: timeouts of 0.25-0.5s with 80ms heartbeats flap when a loaded
 # machine delays scheduler threads past the election window.
-TIMEOUT_SCALE = float(os.environ.get("NOMAD_TPU_RAFT_TIMEOUT_SCALE", "1.0"))
+TIMEOUT_SCALE = env_float("NOMAD_TPU_RAFT_TIMEOUT_SCALE", 1.0)
 
 # Recent entries retained in memory for follower catch-up by re-send
 # (log repair) instead of full-snapshot install.

@@ -315,6 +315,11 @@ class NodeMatrix:
         self._encoder = None
         self._shared_masks: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._shared_zero_i32: Optional[np.ndarray] = None
+        # TSan-lite (lint/tsan.py): lockset checking on _alloc row writes
+        # and the dirty sets when a test enabled the sanitizer.
+        from ..lint.tsan import maybe_instrument
+
+        maybe_instrument("matrix", self)
 
     def shared_encoder(self):
         """The matrix-wide RequestEncoder.  Scheduling stacks are built per

@@ -250,6 +250,13 @@ class StateStore:
         self._history: Dict[Tuple[str, object], List] = {}
         self.history_depth = 4
 
+        # TSan-lite (lint/tsan.py): wraps locks + primary tables with
+        # lockset checking when a test enabled the sanitizer; one global
+        # flag test otherwise.
+        from ..lint.tsan import maybe_instrument
+
+        maybe_instrument("store", self)
+
     # ------------------------------------------------------------------
     # Index bookkeeping / blocking queries
     # ------------------------------------------------------------------
@@ -379,7 +386,7 @@ class StateStore:
 
     @journaled
     def update_node_status(
-        self, index: int, node_id: str, status: str, *, now: float = None
+        self, index: int, node_id: str, status: str, *, now: Optional[float] = None
     ) -> None:
         with self._lock:
             prev = self.nodes.get(node_id)
@@ -619,7 +626,7 @@ class StateStore:
 
     @journaled
     def upsert_allocs(
-        self, index: int, allocs: Iterable[Allocation], *, now: float = None
+        self, index: int, allocs: Iterable[Allocation], *, now: Optional[float] = None
     ) -> None:
         """Insert/replace allocations, keeping the device matrix in sync."""
         with self._lock:
@@ -678,7 +685,7 @@ class StateStore:
 
     @journaled
     def update_allocs_from_client(
-        self, index: int, updates: Iterable[Allocation], *, now: float = None
+        self, index: int, updates: Iterable[Allocation], *, now: Optional[float] = None
     ) -> None:
         """Client status updates (Node.UpdateAlloc path,
         nomad/node_endpoint.go:1054): merge client fields into stored alloc."""
@@ -1205,7 +1212,7 @@ class StateStore:
         deployment_updates: Optional[List] = None,
         evals: Optional[List[Evaluation]] = None,
         *,
-        now: float = None,
+        now: Optional[float] = None,
     ) -> None:
         with self._lock:
             if deployment is not None:

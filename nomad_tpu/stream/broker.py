@@ -61,6 +61,9 @@ class Subscription:
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self.closed = False
+        from ..lint.tsan import maybe_instrument
+
+        maybe_instrument("subscription", self)
 
     def _matches(self, ev: Event) -> bool:
         for topic in (ev.topic, TOPIC_ALL):
@@ -109,6 +112,9 @@ class EventBroker:
         # subscriber with a pre-restart cursor must see a gap marker.
         # A ``from_index`` at or below this cannot be served gaplessly.
         self._dropped_through = 0
+        from ..lint.tsan import maybe_instrument
+
+        maybe_instrument("broker", self)
 
     def mark_history_truncated(self, through_index: int) -> None:
         """Declare that no event with index <= ``through_index`` can be

@@ -390,7 +390,7 @@ class Volume:
     create_index: int = 0
     modify_index: int = 0
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.id:
             self.id = generate_uuid()
         if not self.name:
@@ -555,7 +555,7 @@ class Job:
     # the dispatch-payload task hook.
     payload: str = ""
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.id:
             self.id = generate_uuid()
         if not self.name:
@@ -614,7 +614,7 @@ class Node:
     modify_index: int = 0
     status_updated_at: float = 0.0
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.id:
             self.id = generate_uuid()
         if not self.name:
@@ -777,7 +777,7 @@ class Allocation:
     create_time: float = 0.0
     modify_time: float = 0.0
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.id:
             self.id = generate_uuid()
 
@@ -873,7 +873,7 @@ class Evaluation:
     create_time: float = 0.0
     leader_ack: str = ""  # broker token
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.id:
             self.id = generate_uuid()
         if not self.create_time:
@@ -1021,7 +1021,7 @@ class Deployment:
     create_index: int = 0
     modify_index: int = 0
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not self.id:
             self.id = generate_uuid()
 

@@ -193,6 +193,21 @@ class TestSeams:
             with pytest.raises(RPCError, match="injected server error"):
                 rpc._call("/v1/internal/ping")
 
+    def test_driver_start_exit127(self):
+        # The lint chaos pass (C003) flagged `driver.start` as the one
+        # documented seam no schedule exercised — this covers it at the
+        # driver level: an injected exit127 means the exec "succeeds"
+        # and the child dies immediately with command-not-found.
+        from nomad_tpu.client.driver import MockDriver, TaskHandle
+        from nomad_tpu.structs import Task
+
+        drv = MockDriver()
+        handle = TaskHandle(id="a1", driver="mock", task_name="t", alloc_id="a")
+        with injected(0, [FaultSpec("driver.start", "exit127", at_step=1)]):
+            drv.start_task(handle, Task(name="t"), task_dir="/tmp")
+        res = drv.wait_task(handle, timeout=1.0)
+        assert res is not None and res.exit_code == 127
+
     def test_wal_torn_write_poisons_then_reload_drops_tail(self, tmp_path):
         from nomad_tpu.state.wal import WALWriteError, WriteAheadLog
 
@@ -381,10 +396,22 @@ class TestInvariants:
 
 class TestScenarios:
     def test_leader_kill_mid_apply(self, tmp_path):
-        report = SCENARIOS["leader_kill_mid_apply"](11, str(tmp_path))
+        # TSan-lite rides along: the 3-server cluster (stores, brokers,
+        # matrices) is constructed inside the sanitized block, so every
+        # declared shared object is lockset-checked while the chaos
+        # schedule widens the race windows.
+        from nomad_tpu.lint import tsan
+
+        with tsan.sanitized():
+            report = SCENARIOS["leader_kill_mid_apply"](11, str(tmp_path))
+            races = tsan.reports()
         assert report["violations"] == [], report
         # The delay schedule actually widened the window.
         assert any(k == "delay" for _, k, _ in report["faults"]), report
+        assert races == [], "\n".join(
+            f"{r['label']} {r['op']} in {r['thread']} held={r['held']}\n{r['stack']}"
+            for r in races
+        )
 
     def test_wal_truncation_sweep(self, tmp_path):
         report = SCENARIOS["wal_truncation_sweep"](7, str(tmp_path))

@@ -1,0 +1,568 @@
+"""The analyzer's own test suite: every rule id fires on a minimal
+fixture and stays quiet on the matching clean idiom, plus the baseline
+machinery and the TSan-lite runtime half.
+
+Fixture paths matter: lock resolution keys on the repo-relative module
+suffix (lint/lock_order.py ALIASES), so fixtures masquerade as the real
+modules they exercise rules against.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+
+from nomad_tpu.lint import Baseline, Finding, load_baseline, split_baselined
+from nomad_tpu.lint import chaospass, jaxpass, lockpass, tsan
+
+
+def _lock_findings(src: str, path: str = "nomad_tpu/state/matrix.py"):
+    return lockpass.analyze_sources({path: textwrap.dedent(src)})
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ----------------------------------------------------------------------
+# L001 — lock-order inversion
+# ----------------------------------------------------------------------
+
+class TestL001:
+    def test_direct_inversion_fires(self):
+        fs = _lock_findings(
+            """
+            class NodeMatrix:
+                def bad(self):
+                    with self._host_lock:
+                        with DEVICE_LOCK:
+                            pass
+            """
+        )
+        assert "L001" in _rules(fs), fs
+
+    def test_declared_order_is_clean(self):
+        fs = _lock_findings(
+            """
+            class NodeMatrix:
+                def good(self):
+                    with DEVICE_LOCK:
+                        with self._host_lock:
+                            pass
+            """
+        )
+        assert "L001" not in _rules(fs), fs
+
+    def test_inversion_via_call_fires(self):
+        # bad() holds matrix.host and calls a method whose body acquires
+        # the device lock — the one-level interprocedural walk sees it.
+        fs = _lock_findings(
+            """
+            class NodeMatrix:
+                def _grab_device(self):
+                    with DEVICE_LOCK:
+                        pass
+
+                def bad(self):
+                    with self._host_lock:
+                        self._grab_device()
+            """
+        )
+        assert "L001" in _rules(fs), fs
+
+    def test_reentrant_reacquire_is_clean(self):
+        # install_snapshot's shape: the outer frame already holds the
+        # (reentrant) outermost lock; a callee re-acquiring it adds no
+        # ordering edge.
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def _inner(self):
+                        with self._write_lock:
+                            pass
+
+                    def ok(self):
+                        with self._write_lock, self._lock:
+                            self._inner()
+                """
+            )
+        })
+        assert "L001" not in _rules(fs), fs
+
+
+# ----------------------------------------------------------------------
+# L002 — Condition.wait while holding a foreign lock
+# ----------------------------------------------------------------------
+
+class TestL002:
+    def test_wait_with_foreign_lock_fires(self):
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def bad(self):
+                        with self._lock:
+                            with self._watch_cond:
+                                self._watch_cond.wait()
+                """
+            )
+        })
+        assert "L002" in _rules(fs), fs
+
+    def test_wait_on_own_condvar_is_clean(self):
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def good(self):
+                        with self._watch_cond:
+                            self._watch_cond.wait()
+                """
+            )
+        })
+        assert "L002" not in _rules(fs), fs
+
+
+# ----------------------------------------------------------------------
+# L003 — blocking call inside a critical section
+# ----------------------------------------------------------------------
+
+class TestL003:
+    def test_sleep_under_lock_fires(self):
+        fs = _lock_findings(
+            """
+            import time
+
+            class NodeMatrix:
+                def bad(self):
+                    with self._host_lock:
+                        time.sleep(0.1)
+            """
+        )
+        assert "L003" in _rules(fs), fs
+
+    def test_sleep_outside_lock_is_clean(self):
+        fs = _lock_findings(
+            """
+            import time
+
+            class NodeMatrix:
+                def good(self):
+                    with self._host_lock:
+                        pass
+                    time.sleep(0.1)
+            """
+        )
+        assert "L003" not in _rules(fs), fs
+
+    def test_device_fetch_under_lock_fires(self):
+        fs = _lock_findings(
+            """
+            class NodeMatrix:
+                def bad(self, x):
+                    with self._host_lock:
+                        return np.asarray(x)
+            """
+        )
+        assert "L003" in _rules(fs), fs
+
+    def test_device_ops_under_device_lock_are_exempt(self):
+        # Launch/upload under DEVICE_LOCK is that lock's purpose.
+        fs = _lock_findings(
+            """
+            class NodeMatrix:
+                def good(self):
+                    with DEVICE_LOCK:
+                        self.sync()
+            """
+        )
+        assert "L003" not in _rules(fs), fs
+
+
+# ----------------------------------------------------------------------
+# L004 — literal-bounded condvar wait
+# ----------------------------------------------------------------------
+
+class TestL004:
+    def test_literal_timeout_fires(self):
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def bad(self):
+                        with self._watch_cond:
+                            self._watch_cond.wait(0.2)
+                """
+            )
+        })
+        assert "L004" in _rules(fs), fs
+
+    def test_literal_via_ifexp_assignment_fires(self):
+        # The exact coalescer._next_batch shape this rule was built for.
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def bad(self):
+                        with self._watch_cond:
+                            timeout = 0.2 if self.busy else None
+                            self._watch_cond.wait_for(lambda: True, timeout=timeout)
+                """
+            )
+        })
+        assert "L004" in _rules(fs), fs
+
+    def test_untimed_wait_is_clean(self):
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def good(self):
+                        with self._watch_cond:
+                            self._watch_cond.wait()
+                """
+            )
+        })
+        assert "L004" not in _rules(fs), fs
+
+    def test_parameter_timeout_is_clean(self):
+        # Caller-supplied deadlines (wait_for_index) are an API contract,
+        # not a lost-notify workaround.
+        fs = lockpass.analyze_sources({
+            "nomad_tpu/state/store.py": textwrap.dedent(
+                """
+                class StateStore:
+                    def good(self, timeout=None):
+                        with self._watch_cond:
+                            self._watch_cond.wait(timeout)
+                """
+            )
+        })
+        assert "L004" not in _rules(fs), fs
+
+
+# ----------------------------------------------------------------------
+# J001–J003 — JAX hot path
+# ----------------------------------------------------------------------
+
+class TestJaxPass:
+    def test_host_sync_on_device_value_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                def bad(a, b):
+                    x = jnp.dot(a, b)
+                    return float(x)
+                """
+            )
+        })
+        assert "J001" in _rules(fs), fs
+
+    def test_asarray_on_device_chain_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                def bad(arrays):
+                    packed = kernels.place_batch_live(arrays)
+                    return np.asarray(packed)
+                """
+            )
+        })
+        assert "J001" in _rules(fs), fs
+
+    def test_host_value_sync_is_clean(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                def good(rows):
+                    total = sum(rows)
+                    return float(total)
+                """
+            )
+        })
+        assert "J001" not in _rules(fs), fs
+
+    def test_jit_captured_mutable_global_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                SCALE = [1.0, 2.0]
+
+                @jax.jit
+                def bad(x):
+                    return x * SCALE[0]
+                """
+            )
+        })
+        assert "J002" in _rules(fs), fs
+
+    def test_jit_reading_immutable_global_is_clean(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                SCALE = 2.0
+
+                @jax.jit
+                def good(x):
+                    return x * SCALE
+                """
+            )
+        })
+        assert "J002" not in _rules(fs), fs
+
+    def test_mutable_static_arg_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                kernel = jax.jit(_impl, static_argnames=("shape",))
+
+                def bad(x):
+                    return kernel(x, shape=[4, 4])
+                """
+            )
+        })
+        assert "J003" in _rules(fs), fs
+
+    def test_hashable_static_arg_is_clean(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/ops/fixture.py": textwrap.dedent(
+                """
+                kernel = jax.jit(_impl, static_argnames=("shape",))
+
+                def good(x):
+                    return kernel(x, shape=(4, 4))
+                """
+            )
+        })
+        assert "J003" not in _rules(fs), fs
+
+
+# ----------------------------------------------------------------------
+# C001–C004 — chaos seams
+# ----------------------------------------------------------------------
+
+_DOC = """
+## Seam catalog
+
+| Seam | Where | ctx keys | Kinds honored |
+|---|---|---|---|
+| `rpc.call` | `api/rpc.py` | path | drop |
+| `ghost.seam` | `gone.py` | x | drop |
+| `lonely.seam` | `real.py` | x | drop |
+
+## Retry policy surface (`nomad_tpu/retry.py`)
+
+RPC failover (`api/rpc.py`), bare loop (`client/naked.py`).
+"""
+
+
+class TestChaosPass:
+    def _analyze(self, **over):
+        kw = dict(
+            doc=_DOC,
+            code_seams={
+                "rpc.call": [("nomad_tpu/api/rpc.py", 10)],
+                "lonely.seam": [("nomad_tpu/real.py", 5)],
+                "rogue.seam": [("nomad_tpu/rogue.py", 7)],
+            },
+            exercised={"rpc.call"},
+            retry_sources={
+                "api/rpc.py": "x = retry_call(fn, RetryPolicy())",
+                "client/naked.py": "while True: time.sleep(1)",
+            },
+        )
+        kw.update(over)
+        return chaospass.analyze(**kw)
+
+    def test_stale_documented_seam_fires_c001(self):
+        fs = self._analyze()
+        stale = [f for f in fs if f.rule == "C001"]
+        assert len(stale) == 1 and stale[0].symbol == "ghost.seam", fs
+
+    def test_undocumented_code_seam_fires_c002(self):
+        fs = self._analyze()
+        rogue = [f for f in fs if f.rule == "C002"]
+        assert len(rogue) == 1 and rogue[0].symbol == "rogue.seam", fs
+
+    def test_unexercised_seam_fires_c003(self):
+        fs = self._analyze()
+        dead = [f for f in fs if f.rule == "C003"]
+        assert len(dead) == 1 and dead[0].symbol == "lonely.seam", fs
+
+    def test_retry_drift_fires_c004(self):
+        fs = self._analyze()
+        drift = [f for f in fs if f.rule == "C004"]
+        assert len(drift) == 1 and drift[0].symbol == "client/naked.py", fs
+
+    def test_consistent_surface_is_clean(self):
+        fs = self._analyze(
+            code_seams={
+                "rpc.call": [("nomad_tpu/api/rpc.py", 10)],
+                "ghost.seam": [("nomad_tpu/gone.py", 3)],
+                "lonely.seam": [("nomad_tpu/real.py", 5)],
+            },
+            exercised={"rpc.call", "ghost.seam", "lonely.seam"},
+            retry_sources={
+                "api/rpc.py": "retry_call(fn)",
+                "client/naked.py": "RetryPolicy()",
+            },
+        )
+        assert fs == [], fs
+
+    def test_real_doc_parses(self):
+        from nomad_tpu.lint import repo_root
+
+        import os
+
+        with open(os.path.join(repo_root(), "CHAOS.md")) as fh:
+            seams, retry_mods = chaospass.parse_doc(fh.read())
+        assert "rpc.call" in seams and "raft.send" in seams
+        assert any(m.endswith("rpc.py") for m in retry_mods)
+
+
+# ----------------------------------------------------------------------
+# Baseline machinery
+# ----------------------------------------------------------------------
+
+class TestBaseline:
+    def test_suppression_and_stale_reporting(self):
+        f1 = Finding("L003", "a.py", 10, "C.m", "x")
+        f2 = Finding("L001", "b.py", 20, "D.n", "y")
+        bl = Baseline(entries=[
+            {"rule": "L003", "path": "a.py", "symbol": "C.m", "why": "ok"},
+            {"rule": "L004", "path": "z.py", "symbol": "E.o", "why": "gone"},
+        ])
+        new, suppressed, stale = split_baselined([f1, f2], bl)
+        assert [f.rule for f in new] == ["L001"]
+        assert [f.rule for f in suppressed] == ["L003"]
+        assert [e["rule"] for e in stale] == ["L004"]
+
+    def test_symbol_keying_survives_line_churn(self):
+        bl = Baseline(entries=[
+            {"rule": "L003", "path": "a.py", "symbol": "C.m", "why": "ok"},
+        ])
+        moved = Finding("L003", "a.py", 999, "C.m", "x")
+        assert bl.match(moved) is not None
+
+    def test_committed_baseline_loads_with_justifications(self):
+        bl = load_baseline()
+        assert bl.entries, "committed baseline should not be empty"
+        assert all(e.get("why") for e in bl.entries)
+
+
+# ----------------------------------------------------------------------
+# TSan-lite runtime half
+# ----------------------------------------------------------------------
+
+class TestTsan:
+    def _locked_pair(self):
+        tl = tsan.TrackedLock(threading.Lock(), "g")
+        info = tsan._ObjInfo("obj", (tl,))
+        return tl, info
+
+    def test_unguarded_second_thread_reports(self):
+        tl, info = self._locked_pair()
+        d = tsan._wrap_container({}, info)
+        tsan.enable()
+        try:
+            d["a"] = 1  # exclusive owner
+            t = threading.Thread(target=lambda: d.update(b=2), name="rogue")
+            t.start()
+            t.join()
+            reports = tsan.reports()
+        finally:
+            tsan.disable()
+        assert len(reports) == 1
+        assert reports[0]["label"] == "obj" and reports[0]["thread"] == "rogue"
+
+    def test_guarded_access_is_clean(self):
+        tl, info = self._locked_pair()
+        d = tsan._wrap_container({}, info)
+        tsan.enable()
+        try:
+            d["a"] = 1
+
+            def guarded():
+                with tl:
+                    d["b"] = 2
+
+            t = threading.Thread(target=guarded)
+            t.start()
+            t.join()
+            with tl:
+                d["c"] = 3
+            reports = tsan.reports()
+        finally:
+            tsan.disable()
+        assert reports == [], reports
+
+    def test_single_thread_never_checked(self):
+        _tl, info = self._locked_pair()
+        d = tsan._wrap_container({}, info)
+        tsan.enable()
+        try:
+            for i in range(10):
+                d[i] = i  # no lock, one thread: exclusive = free
+            reports = tsan.reports()
+        finally:
+            tsan.disable()
+        assert reports == []
+
+    def test_wrapped_condition_round_trips(self):
+        import time
+
+        lock = threading.RLock()
+        cond = threading.Condition(lock)
+        tl = tsan.TrackedLock(lock, "c")
+        tsan._rebind_condition(cond, tl)
+        box = []
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: box, timeout=2)
+                box.append("woke")
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            box.append(1)
+            cond.notify_all()
+        t.join()
+        assert box == [1, "woke"]
+        assert tsan.held_names() == frozenset()
+
+    def test_array_view_writes_checked_but_derived_copies_free(self):
+        import numpy as np
+
+        tl = tsan.TrackedLock(threading.Lock(), "g")
+        info = tsan._ObjInfo("arr", (tl,), writes_only=True)
+        a = tsan._wrap_container(np.zeros((4, 3)), info)
+        tsan.enable()
+        try:
+            a[0] = 1.0  # exclusive
+            view = a[1:]
+            derived = a * 2  # fresh buffer — must NOT carry the monitor
+
+            def rogue():
+                view[0] = 2.0      # unguarded view write: reported
+                derived[0] = 9.0   # scratch write: free
+
+            t = threading.Thread(target=rogue)
+            t.start()
+            t.join()
+            reports = tsan.reports()
+        finally:
+            tsan.disable()
+        assert len(reports) == 1 and reports[0]["label"] == "arr", reports
+
+    def test_disabled_is_noop(self):
+        assert not tsan.enabled()
+        _tl, info = self._locked_pair()
+        d = tsan._wrap_container({}, info)
+        d["a"] = 1
+        t = threading.Thread(target=lambda: d.update(b=2))
+        t.start()
+        t.join()
+        assert tsan.reports() == []

@@ -1,0 +1,27 @@
+"""The lint gate: tier-1 runs the full analyzer in-process and fails on
+any non-baselined finding — `python -m nomad_tpu.lint` as a pytest node,
+so the gate rides the existing test command with no new CI surface."""
+
+from __future__ import annotations
+
+from nomad_tpu.lint import load_baseline, repo_root, run_all, split_baselined
+
+
+def test_analyzer_is_clean_against_baseline():
+    findings = run_all(repo_root())
+    baseline = load_baseline()
+    new, _suppressed, stale = split_baselined(findings, baseline)
+    assert new == [], "non-baselined findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    # The ratchet: entries that stopped matching anything must be deleted,
+    # not accumulated.
+    assert stale == [], "stale baseline entries (delete them):\n" + "\n".join(
+        f"{e.get('rule')} {e.get('path')} [{e.get('symbol')}]" for e in stale
+    )
+
+
+def test_every_baseline_entry_has_a_justification():
+    baseline = load_baseline()
+    missing = [e for e in baseline.entries if not e.get("why")]
+    assert missing == [], missing

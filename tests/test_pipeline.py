@@ -87,6 +87,13 @@ class TestPipelineParity:
         (matrix arrays, request), so batching/overlap may not leak in."""
         monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICE", "1")
         monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICE_LATENCY_MS", "10")
+        # TSan-lite rides along: matrix + coalescers are built inside the
+        # sanitized block, so 8 worker threads x pipelined resolver get
+        # lockset-checked under the chaos-perturbed batch boundaries.
+        from nomad_tpu.lint import tsan
+
+        self._tsan = tsan
+        tsan.enable()
         m = _matrix(8)
         jobs = [mock.job() for _ in range(24)]
         for i, j in enumerate(jobs):
@@ -114,8 +121,16 @@ class TestPipelineParity:
         def run_outcomes(coal):
             return _drive(coal, inputs, n_threads=8)
 
-        serial = run(depth=1, seed=11)
-        piped = run(depth=8, seed=23)
+        try:
+            serial = run(depth=1, seed=11)
+            piped = run(depth=8, seed=23)
+            races = tsan.reports()
+        finally:
+            tsan.disable()
+        assert races == [], "\n".join(
+            f"{r['label']} {r['op']} in {r['thread']} held={r['held']}\n{r['stack']}"
+            for r in races
+        )
 
         for i, (a, b) in enumerate(zip(serial, piped)):
             np.testing.assert_array_equal(

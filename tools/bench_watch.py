@@ -32,12 +32,21 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nomad_tpu.retry import (  # noqa: E402
+    RetryBudgetExceeded,
+    RetryPolicy,
+    env_int,
+    retry_call,
+)
+
 EVIDENCE = os.path.join(REPO, "BENCH_tpu_evidence.json")
-PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+PROBE_TIMEOUT = env_int("BENCH_PROBE_TIMEOUT", 150)
 # The bench itself retries internally; this bound only reaps a run that
 # wedges mid-flight AFTER a healthy probe (observed failure mode: tunnel
 # dies between probe and pipelined phase).
-BENCH_TIMEOUT = int(os.environ.get("BENCH_WATCH_BENCH_TIMEOUT", "1800"))
+BENCH_TIMEOUT = env_int("BENCH_WATCH_BENCH_TIMEOUT", 1800)
 
 
 def probe() -> str:
@@ -102,36 +111,55 @@ def main() -> int:
         return 0
 
     attempts = 1 if args.once else args.attempts
-    for attempt in range(1, attempts + 1):
+    seen = {"n": 0}
+
+    class _NoEvidence(Exception):
+        """This attempt produced no TPU artifact — retry on schedule."""
+
+    def attempt_once() -> dict:
+        seen["n"] += 1
         plat = probe()
         sys.stderr.write(
-            f"bench_watch: probe {attempt}/{attempts}: {plat}\n"
+            f"bench_watch: probe {seen['n']}/{attempts}: {plat}\n"
         )
-        if plat and not plat.startswith("err:") and plat != "cpu":
-            result = run_bench()
-            if result is not None and result.get("platform") != "cpu":
-                result["captured_by"] = "tools/bench_watch.py"
-                result["captured_at"] = time.strftime(
-                    "%Y-%m-%dT%H:%M:%S%z"
-                )
-                tmp = EVIDENCE + ".tmp"
-                with open(tmp, "w") as fh:
-                    json.dump(result, fh, indent=2)
-                    fh.write("\n")
-                os.replace(tmp, EVIDENCE)
-                sys.stderr.write(
-                    f"bench_watch: evidence written -> {EVIDENCE} "
-                    f"(value={result.get('value')})\n"
-                )
-                return 0
+        if not plat or plat.startswith("err:") or plat == "cpu":
+            raise _NoEvidence(f"probe: {plat}")
+        result = run_bench()
+        if result is None or result.get("platform") == "cpu":
             sys.stderr.write(
                 "bench_watch: probe was healthy but the bench run "
                 "fell back / died; retrying\n"
             )
-        if attempt < attempts:
-            time.sleep(args.interval)
-    sys.stderr.write("bench_watch: budget exhausted, no TPU evidence\n")
-    return 1
+            raise _NoEvidence("bench fell back / died")
+        return result
+
+    # Flat (multiplier=1, no jitter) schedule: probing a wedged tunnel
+    # faster doesn't unwedge it, and the operator asked for --interval.
+    policy = RetryPolicy(
+        base_delay=args.interval, multiplier=1.0, jitter=0.0,
+        max_attempts=attempts,
+    )
+    try:
+        result = retry_call(
+            attempt_once, policy, retry_on=(_NoEvidence,),
+            description="tpu evidence probe",
+        )
+    except RetryBudgetExceeded:
+        sys.stderr.write("bench_watch: budget exhausted, no TPU evidence\n")
+        return 1
+
+    result["captured_by"] = "tools/bench_watch.py"
+    result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    tmp = EVIDENCE + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(result, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, EVIDENCE)
+    sys.stderr.write(
+        f"bench_watch: evidence written -> {EVIDENCE} "
+        f"(value={result.get('value')})\n"
+    )
+    return 0
 
 
 if __name__ == "__main__":

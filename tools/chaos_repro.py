@@ -26,10 +26,14 @@ import os
 import sys
 import tempfile
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("NOMAD_TPU_RAFT_TIMEOUT_SCALE", "2.0")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nomad_tpu.retry import env_defaults  # noqa: E402
+
+# Pin the rig BEFORE any jax-adjacent import: cpu backend, and the same
+# doubled raft timeouts tests/conftest.py uses, so a replay sees the
+# exact timing regime the failing test did.
+env_defaults(JAX_PLATFORMS="cpu", NOMAD_TPU_RAFT_TIMEOUT_SCALE="2.0")
 
 
 def main(argv=None) -> int:

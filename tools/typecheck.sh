@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Typing gate: mypy (non-strict, --check-untyped-defs) over the
+# declarative layers — nomad_tpu/structs/ (wire/serde contracts) and
+# nomad_tpu/lint/ (the analyzer itself).  Config: mypy.ini.
+#
+# Exits 0 with a notice when mypy is not installed (the CI image may not
+# ship it; the gate must not invent a dependency) — run
+#   pip install mypy && tools/typecheck.sh
+# locally for the real check.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! python -m mypy --version >/dev/null 2>&1; then
+    echo "typecheck: mypy not installed — skipping (pip install mypy to enable)"
+    exit 0
+fi
+
+exec python -m mypy --config-file mypy.ini nomad_tpu/structs/ nomad_tpu/lint/
