@@ -317,6 +317,25 @@ def build_requests(m):
     return shapes
 
 
+def _phase_breakdown(registry) -> dict:
+    """Fold a registry's ``nomad.phase.*`` trace histograms into the
+    per-phase latency table the BENCH json reports: where an eval's wall
+    clock went — queue-wait vs host orchestration vs device RTT."""
+    from nomad_tpu.trace import PHASE_PREFIX
+
+    out = {}
+    for key, val in registry.snapshot().items():
+        if not key.startswith(PHASE_PREFIX) or not isinstance(val, dict):
+            continue
+        out[key[len(PHASE_PREFIX):]] = {
+            "count": val["count"],
+            "p50_ms": val["p50_ms"],
+            "p99_ms": val["p99_ms"],
+            "total_ms": round(val["mean_ms"] * val["count"], 1),
+        }
+    return out
+
+
 def bench_kernel(result: dict) -> None:
     """Kernel dispatch phase.
 
@@ -415,12 +434,21 @@ def bench_kernel(result: dict) -> None:
         )
 
     np.asarray(dispatch_interactive().rows)  # compile for the small shape
+    from nomad_tpu import trace
+    from nomad_tpu.metrics import MetricsRegistry
+
+    reg_i = MetricsRegistry()
     it = []
     for _ in range(DISPATCHES):
         t = time.time()
-        np.asarray(dispatch_interactive().rows)
+        with trace.span("interactive.dispatch", metrics=reg_i):
+            out_i = dispatch_interactive()
+        with trace.span("interactive.fetch", metrics=reg_i):
+            np.asarray(out_i.rows)
         it.append(time.time() - t)
     iarr = np.array(it)
+    # Launch vs device→host fetch split for the interactive burst.
+    result["interactive_phase_ms"] = _phase_breakdown(reg_i)
     result.update(
         interactive_batch=INTERACTIVE_BATCH,
         interactive_dispatch_p50_ms=round(
@@ -678,6 +706,7 @@ def bench_host_only(result: dict) -> None:
             e2e_host_only_jobs=HOST_ONLY_JOBS,
             e2e_host_only_nodes=HOST_ONLY_NODES,
             e2e_host_only_workers=HOST_ONLY_WORKERS,
+            e2e_host_only_phase_ms=_phase_breakdown(srv.metrics),
         )
     finally:
         if srv is not None:
@@ -761,15 +790,21 @@ def bench_live_pipeline(result: dict) -> None:
                     "evals", last_index, timeout=0.25
                 )
             wall = time.time() - t0
-            return (LIVE_JOBS - len(pending)) / wall
+            # Per-depth phase split: deeper pipelines should move time
+            # out of coalescer.device (overlapped) into queue phases.
+            return (LIVE_JOBS - len(pending)) / wall, _phase_breakdown(
+                srv.metrics
+            )
         finally:
             srv.shutdown()
 
     try:
         rates = {}
         for depth in LIVE_DEPTHS:
-            rates[depth] = round(one_depth(depth), 1)
+            rate, phases = one_depth(depth)
+            rates[depth] = round(rate, 1)
             result[f"live_pipeline_evals_per_sec_depth{depth}"] = rates[depth]
+            result[f"live_pipeline_phase_ms_depth{depth}"] = phases
         result.update(
             live_pipeline_latency_ms=LIVE_LATENCY_MS,
             live_pipeline_jobs=LIVE_JOBS,

@@ -150,39 +150,11 @@ class Agent:
                         s.store.events.subscriber_count(),
                 }
             )
-            # Coalescer pipeline telemetry: occupancy, lane packing, and
-            # the stale-dispatch tax of overlapping launches (queue-wait
-            # percentiles ride in via the metrics snapshot below).
-            c = s.coalescer
-            out.update(
-                {
-                    "nomad.coalescer.pipeline_depth": c.pipeline_depth,
-                    "nomad.coalescer.inflight_depth": c.inflight_depth(),
-                    "nomad.coalescer.dispatches": c.dispatches,
-                    "nomad.coalescer.coalesced_requests":
-                        c.coalesced_requests,
-                    "nomad.coalescer.lane_fill_ratio": round(
-                        c.coalesced_requests
-                        / (c.dispatches * c.max_lanes or 1),
-                        4,
-                    ),
-                    "nomad.coalescer.stale_dispatches": c.stale_dispatches,
-                    # Matrix transfer telemetry: steady-state syncs should
-                    # scatter O(dirty rows), not re-upload the matrix.
-                    "nomad.matrix.full_uploads": s.matrix.full_uploads,
-                    "nomad.matrix.scatter_syncs": s.matrix.scatter_syncs,
-                    "nomad.matrix.rows_scattered_total":
-                        s.matrix.rows_scattered_total,
-                    "nomad.matrix.rows_per_scatter": round(
-                        s.matrix.rows_scattered_total
-                        / (s.matrix.scatter_syncs or 1),
-                        2,
-                    ),
-                    "nomad.matrix.upload_bytes_total":
-                        s.matrix.upload_bytes_total,
-                }
-            )
-            # Latency timers (worker.go:245, plan_apply.go:185,370 analogs).
+            # Coalescer pipeline + matrix transfer + per-kernel cost
+            # attribution now ride in as registry pull gauges (registered
+            # by Server._register_telemetry_gauges, same key names), and
+            # the latency timers (worker.go:245, plan_apply.go:185,370
+            # analogs) plus nomad.phase.* trace histograms alongside them.
             out.update(s.metrics.snapshot())
         if self.client is not None:
             out["client.allocs_running"] = self.client.num_allocs()

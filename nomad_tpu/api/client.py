@@ -378,3 +378,48 @@ class APIClient:
 
     def metrics(self) -> Dict:
         return self._call("GET", "/v1/metrics")
+
+    def metrics_prometheus(self) -> str:
+        """Prometheus text exposition of the agent's metrics registry."""
+        return self._call_raw("GET", "/v1/metrics?format=prometheus").decode()
+
+    # Tracing -----------------------------------------------------------
+
+    def trace_records(
+        self, limit: Optional[int] = None, clear: bool = False
+    ) -> Dict:
+        qs = []
+        if limit is not None:
+            qs.append(f"limit={limit}")
+        if clear:
+            qs.append("clear=1")
+        suffix = "?" + "&".join(qs) if qs else ""
+        return self._call("GET", f"/v1/trace{suffix}")
+
+    def trace_dump(self, limit: Optional[int] = None) -> bytes:
+        """Chrome trace-event JSON body (Perfetto-loadable), as bytes."""
+        suffix = "&limit=%d" % limit if limit is not None else ""
+        return self._call_raw("GET", f"/v1/trace?format=chrome{suffix}")
+
+    def trace_config(self) -> Dict:
+        return self._call("GET", "/v1/trace/config")
+
+    def trace_configure(self, **kwargs) -> Dict:
+        return self._call("PUT", "/v1/trace/config", kwargs)
+
+    def _call_raw(self, method: str, path: str) -> bytes:
+        headers = {}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
+        req = urllib.request.Request(
+            f"{self.address}{path}", method=method, headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as exc:
+            try:
+                msg = json.loads(exc.read()).get("error", str(exc))
+            except Exception:  # noqa: BLE001
+                msg = str(exc)
+            raise APIError(exc.code, msg) from exc

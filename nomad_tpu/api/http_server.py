@@ -44,6 +44,15 @@ class HTTPError(Exception):
         self.message = message
 
 
+@dataclasses.dataclass
+class RawResponse:
+    """A route() result that bypasses JSON serialization — for non-JSON
+    content types (Prometheus text exposition, pre-encoded traces)."""
+
+    body: bytes
+    content_type: str = "text/plain; charset=utf-8"
+
+
 class HTTPAPIServer:
     """Routes requests onto the in-process agent (server and/or client)."""
 
@@ -134,7 +143,12 @@ class HTTPAPIServer:
                             "X-Nomad-Cluster-Secret", ""
                         ),
                     )
-                    self._respond(200, result)
+                    if isinstance(result, RawResponse):
+                        api._raw_respond(
+                            self, 200, result.body, result.content_type
+                        )
+                    else:
+                        self._respond(200, result)
                 except HTTPError as exc:
                     self._respond(exc.code, {"error": exc.message})
                 except Exception as exc:  # noqa: BLE001
@@ -1572,7 +1586,53 @@ class HTTPAPIServer:
                 return {"Updated": True}
 
         if path == "/v1/metrics" and method == "GET":
-            return self.agent.metrics()
+            snap = self.agent.metrics()
+            if query.get("format") == "prometheus":
+                from ..metrics import to_prometheus
+
+                return RawResponse(
+                    to_prometheus(snap).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            return snap
+
+        if path == "/v1/trace" and method == "GET":
+            from .. import trace as _trace
+
+            limit = None
+            if query.get("limit"):
+                try:
+                    limit = int(query["limit"])
+                except ValueError:
+                    raise HTTPError(400, "limit must be an integer")
+            records = _trace.dump(limit=limit)
+            if query.get("clear") in ("1", "true"):
+                _trace.clear()
+            if query.get("format") == "chrome":
+                # Perfetto-loadable body, ready to save to a file
+                # (`nomad trace dump` fetches this).
+                return RawResponse(
+                    json.dumps(_trace.chrome_trace(records)).encode(),
+                    "application/json",
+                )
+            return {
+                "records": records,
+                "count": len(records),
+                "config": _trace.config(),
+            }
+
+        if path == "/v1/trace/config":
+            from .. import trace as _trace
+
+            if method == "GET":
+                return _trace.config()
+            if method in ("PUT", "POST"):
+                b = body or {}
+                return _trace.configure(
+                    enabled=b.get("enabled"),
+                    sample=b.get("sample"),
+                    ring=b.get("ring"),
+                )
 
         raise HTTPError(404, f"no handler for {method} {path}")
 

@@ -24,6 +24,7 @@ import urllib.error
 import urllib.request
 from typing import List, Tuple
 
+from .. import trace
 from ..chaos import inject
 from ..retry import RetryBudgetExceeded, RetryPolicy, retry_call
 from ..structs import serde
@@ -53,6 +54,7 @@ class HTTPServerRPC:
         # Chaos seam: a request can be lost, erred, delayed (handled inside
         # inject), or duplicated before it ever reaches the wire.
         fault = inject("rpc.call", path=path, addr=self.addr)
+        trace.event("seam.rpc.call", path=path)
         if fault is not None:
             if fault.kind == "drop":
                 raise RPCError(f"{path}: injected connection drop")

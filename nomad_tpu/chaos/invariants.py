@@ -214,4 +214,14 @@ def check_cluster(
     subject = leader if leader is not None else (servers[0] if servers else None)
     if subject is not None:
         violations += check_store(subject)
+    if violations:
+        # Post-mortem: persist the flight recorder next to the chaos seed
+        # so the violated run's span timeline survives the process.
+        from .. import trace
+
+        path = trace.auto_dump(
+            "invariant", extra={"violations": violations[:20]}
+        )
+        if path:
+            violations = violations + [f"flight record dumped: {path}"]
     return violations

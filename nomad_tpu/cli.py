@@ -660,7 +660,79 @@ def cmd_operator_scheduler(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    _print(_client(args).metrics())
+    client = _client(args)
+    if args.watch:
+        return _watch_metrics(client, args)
+    if args.format == "prometheus":
+        sys.stdout.write(client.metrics_prometheus())
+        return 0
+    _print(client.metrics())
+    return 0
+
+
+def _watch_metrics(client, args) -> int:
+    """Poll /v1/metrics and print per-interval deltas for counters (and
+    current values for gauges) — `vmstat` for the cluster."""
+    prev = None
+    rounds = 0
+    try:
+        while args.count <= 0 or rounds < args.count:
+            snap = client.metrics()
+            flat = {
+                k: v for k, v in snap.items()
+                if isinstance(v, (int, float))
+            }
+            if prev is not None:
+                deltas = {}
+                for k, v in sorted(flat.items()):
+                    d = v - prev.get(k, 0)
+                    if d != 0:
+                        deltas[k] = round(d, 6)
+                stamp = time.strftime("%H:%M:%S")
+                if deltas:
+                    print(f"--- {stamp} (+{args.interval:g}s) ---")
+                    for k, d in deltas.items():
+                        sign = "+" if d > 0 else ""
+                        print(f"  {k}: {sign}{d:g}  (now {flat[k]:g})")
+                else:
+                    print(f"--- {stamp} no change ---")
+                rounds += 1
+            prev = flat
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_trace_dump(args) -> int:
+    body = _client(args).trace_dump(limit=args.limit)
+    if args.output:
+        with open(args.output, "wb") as fh:
+            fh.write(body)
+        doc = json.loads(body)
+        n = len(doc.get("traceEvents", []))
+        print(f"wrote {n} trace events to {args.output}")
+        print("open in https://ui.perfetto.dev (drag the file in)")
+    else:
+        sys.stdout.write(body.decode())
+    return 0
+
+
+def cmd_trace_config(args) -> int:
+    client = _client(args)
+    updates = {}
+    if args.sample is not None:
+        updates["sample"] = args.sample
+    if args.ring is not None:
+        updates["ring"] = args.ring
+    if args.enable:
+        updates["enabled"] = True
+    if args.disable:
+        updates["enabled"] = False
+    if updates:
+        _print(client.trace_configure(**updates))
+    else:
+        _print(client.trace_config())
     return 0
 
 
@@ -918,7 +990,30 @@ def build_parser() -> argparse.ArgumentParser:
     sched.set_defaults(fn=cmd_operator_scheduler)
 
     metrics = sub.add_parser("metrics", help="agent metrics")
+    metrics.add_argument("--format", choices=["json", "prometheus"],
+                         default="json")
+    metrics.add_argument("--watch", action="store_true",
+                         help="poll and print per-interval counter deltas")
+    metrics.add_argument("--interval", type=float, default=2.0)
+    metrics.add_argument("--count", type=int, default=0,
+                         help="stop after N delta rounds (0 = forever)")
     metrics.set_defaults(fn=cmd_metrics)
+
+    tr = sub.add_parser("trace", help="eval-lifecycle tracing").add_subparsers(
+        dest="trace_cmd", required=True
+    )
+    tdump = tr.add_parser("dump", help="fetch Chrome/Perfetto trace JSON")
+    tdump.add_argument("-o", "--output", default="",
+                       help="write to file instead of stdout")
+    tdump.add_argument("--limit", type=int, default=None,
+                       help="most-recent N spans only")
+    tdump.set_defaults(fn=cmd_trace_dump)
+    tcfg = tr.add_parser("config", help="show or adjust trace sampling")
+    tcfg.add_argument("--sample", type=float, default=None)
+    tcfg.add_argument("--ring", type=int, default=None)
+    tcfg.add_argument("--enable", action="store_true")
+    tcfg.add_argument("--disable", action="store_true")
+    tcfg.set_defaults(fn=cmd_trace_config)
 
     lint = sub.add_parser(
         "lint", help="static analysis: lock discipline, JAX hot path, chaos seams"

@@ -19,6 +19,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .. import trace
 from ..chaos import inject
 from ..retry import Backoff, RetryPolicy, retry_call
 from ..structs.types import (
@@ -268,6 +269,7 @@ class Client:
                 # a slow heartbeat that still lands within TTL must be
                 # harmless.
                 fault = inject("client.heartbeat", node=self.node.id)
+                trace.event("seam.client.heartbeat", node=self.node.id)
                 if fault is not None:
                     if fault.kind == "skip":
                         continue

@@ -27,6 +27,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from .. import trace
 from ..chaos import inject
 from ..retry import RetryBudgetExceeded, RetryPolicy, retry_call
 from ..structs.types import Task
@@ -68,6 +69,7 @@ def _chaos(point: str, driver: str, task: str):
     at start, "wedge" at wait, "skip" at stop — is returned for the
     caller to act on, since only it can fabricate the right outcome."""
     fault = inject(point, driver=driver, task=task)
+    trace.event("seam." + point, driver=driver, task=task)
     if fault is None:
         return None
     if fault.kind == "hang":

@@ -13,6 +13,9 @@ Three AST passes over the production tree, one runtime sanitizer:
 * **chaos seams** (:mod:`.chaospass`, rules ``C001``–``C004``) — the
   CHAOS.md seam catalog and retry surface cross-checked against the
   injector call sites and the tests that exercise them.
+* **observability** (:mod:`.obspass`, rule ``O001``) — every injector
+  call site must emit a trace event on the same path, so chaos faults
+  are visible in flight-recorder dumps.
 * **TSan-lite** (:mod:`.tsan`) — the runtime half: lockset-checked
   shared-state wrappers enabled under the seeded chaos scenarios.
 
@@ -74,13 +77,14 @@ def repo_root(start: Optional[str] = None) -> str:
 
 def run_all(root: Optional[str] = None) -> List[Finding]:
     """Run every pass over the repo; returns findings sorted by path/line."""
-    from . import chaospass, jaxpass, lockpass
+    from . import chaospass, jaxpass, lockpass, obspass
 
     root = root or repo_root()
     findings: List[Finding] = []
     findings += lockpass.run(root)
     findings += jaxpass.run(root)
     findings += chaospass.run(root)
+    findings += obspass.run(root)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

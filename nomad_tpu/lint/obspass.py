@@ -1,0 +1,143 @@
+"""Observability pass (rule O001).
+
+The flight recorder is only as good as its coverage: a chaos seam that
+fires without leaving a trace event is invisible in the post-mortem
+dump, so a fault-triggered failure can't be lined up against the spans
+it perturbed.  The contract is simple — **every injector call site must
+emit a trace event on the same path** — and this pass enforces it:
+
+* **O001 seam without trace emission** — an ``inject(...)``/``_chaos(...)``
+  call site with a literal seam string whose enclosing function never
+  calls ``trace.event``/``trace.span``/``trace.record_span``, and whose
+  injector function is not a module-local wrapper that emits the event
+  itself (driver.py's ``_chaos`` pattern).
+
+Shares the seam-site discovery with :mod:`.chaospass` (same
+``INJECT_FUNC_NAMES``, same tree walk) so the two passes can't drift
+apart on what counts as a seam.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set, Tuple
+
+from . import Finding
+from .chaospass import INJECT_FUNC_NAMES
+
+# The trace-emission surface: any of these reached from a seam's
+# enclosing function satisfies O001.
+TRACE_EMIT_NAMES = frozenset({"event", "span", "record_span"})
+
+_SKIP_FILES = ("chaos/injector.py", "chaos/scenarios.py")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _emits_trace(node: ast.AST) -> bool:
+    """Does this subtree contain a trace-emission call?  Nested function
+    definitions are NOT descended into — a trace call in an inner
+    closure is its own path, not this one's."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(child, ast.Call) and _call_name(child) in TRACE_EMIT_NAMES:
+            return True
+        if _emits_trace(child):
+            return True
+    return False
+
+
+def _literal_seam_calls(
+    body: ast.AST,
+) -> List[Tuple[str, str, int]]:
+    """(injector func name, seam string, line) for literal calls directly
+    inside ``body`` (not inside nested defs)."""
+    out: List[Tuple[str, str, int]] = []
+    for child in ast.iter_child_nodes(body):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(child, ast.Call):
+            fname = _call_name(child)
+            if fname in INJECT_FUNC_NAMES and child.args:
+                first = child.args[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    out.append((fname, first.value, child.lineno))
+        out.extend(_literal_seam_calls(child))
+    return out
+
+
+def analyze_module(rel: str, src: str) -> List[Finding]:
+    """Pure per-module check — the test fixture API."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+
+    # Module-local injector wrappers that emit the event themselves
+    # (driver.py's ``def _chaos(point, ...): ... trace.event(...)``):
+    # calls THROUGH them are covered regardless of the caller's body.
+    covered_wrappers: Set[str] = set()
+    funcs: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                funcs.append((qual, child))
+                if child.name in INJECT_FUNC_NAMES and _emits_trace(child):
+                    covered_wrappers.add(child.name)
+                visit(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}.{child.name}" if prefix else child.name)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+
+    findings: List[Finding] = []
+    scopes: List[Tuple[str, ast.AST]] = [("<module>", tree)] + funcs
+    for qual, scope in scopes:
+        seam_calls = _literal_seam_calls(scope)
+        if not seam_calls:
+            continue
+        emits = _emits_trace(scope)
+        for fname, seam, line in seam_calls:
+            if fname in covered_wrappers:
+                continue  # the wrapper emits the event for every caller
+            if emits:
+                continue
+            findings.append(Finding(
+                "O001", rel, line, qual,
+                f"chaos seam `{seam}` fires here but `{qual}` never emits "
+                f"a trace event (trace.event/span/record_span) — the fault "
+                f"is invisible in flight-recorder dumps",
+            ))
+    return findings
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    pkg = os.path.join(root, "nomad_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d not in ("__pycache__", "lint")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, root).replace(os.sep, "/")
+            if rel.endswith(_SKIP_FILES):
+                continue
+            with open(p) as fh:
+                src = fh.read()
+            findings.extend(analyze_module(rel, src))
+    return findings

@@ -5,15 +5,25 @@
 A ``Timer`` keeps cheap streaming aggregates (count/sum/min/max) plus a
 bounded reservoir for percentiles — enough for the p99-latency SLO the
 BASELINE tracks, without a dependency.
+
+Counters take optional labels (``incr("nomad.kernel.launches",
+path="solo")``), stored flat under ``name{k=v,...}`` keys so snapshots
+stay JSON-plain. ``gauge_fn`` registers a callable polled at snapshot
+time — how scattered object counters (matrix uploads, coalescer
+dispatches) unify into the registry without double bookkeeping.
+``to_prometheus`` renders any snapshot in the Prometheus text
+exposition format for ``/v1/metrics?format=prometheus``.
 """
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Dict, List
+from typing import Callable, Dict, List
 
 
 class Timer:
@@ -44,9 +54,15 @@ class Timer:
             self.observe(time.time() - t0)
 
     def _percentile(self, sorted_samples: List[float], q: float) -> float:
+        # Ceil-rank (nearest-rank) definition: the smallest sample with
+        # at least q of the distribution at or below it. The old
+        # ``int(q * n)`` floor under-reported p99 for small reservoirs
+        # (p99 of 100 samples indexed [99] only by the clamp; p99 of 10
+        # picked the 10th-largest's neighbor at n=1000 boundaries).
         if not sorted_samples:
             return 0.0
-        idx = min(len(sorted_samples) - 1, int(q * len(sorted_samples)))
+        rank = math.ceil(q * len(sorted_samples))
+        idx = min(len(sorted_samples) - 1, max(0, rank - 1))
         return sorted_samples[idx]
 
     def snapshot(self) -> Dict[str, float]:
@@ -66,11 +82,21 @@ class Timer:
         }
 
 
+def labeled(name: str, **labels) -> str:
+    """Flatten ``name`` + labels into the canonical ``name{k=v,...}``
+    snapshot key (labels sorted, so the key is stable)."""
+    if not labels:
+        return name
+    inner = ",".join("%s=%s" % (k, labels[k]) for k in sorted(labels))
+    return "%s{%s}" % (name, inner)
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._timers: Dict[str, Timer] = {}
         self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
 
     def timer(self, name: str) -> Timer:
         with self._lock:
@@ -80,17 +106,106 @@ class MetricsRegistry:
                 self._timers[name] = t
             return t
 
-    def incr(self, name: str, by: int = 1) -> None:
+    def incr(self, name: str, by: int = 1, **labels) -> None:
+        key = labeled(name, **labels)
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + by
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def gauge_fn(self, name: str, fn: Callable[[], float], **labels) -> None:
+        """Register a pull gauge: ``fn`` is polled at snapshot time.
+        Lets object-owned counters (matrix.scatter_syncs, coalescer
+        dispatch tallies) surface in the registry without a second
+        write on every hot-path increment."""
+        with self._lock:
+            self._gauges[labeled(name, **labels)] = fn
 
     def snapshot(self) -> Dict:
         with self._lock:
             timers = dict(self._timers)
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
         out: Dict = {}
         for name, value in counters.items():
             out[name] = value
+        for name, fn in gauges.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                # A gauge over a torn-down object must not break /v1/metrics.
+                out[name] = 0
         for name, t in timers.items():
             out[name] = t.snapshot()
         return out
+
+
+# ----------------------------------------------------------------------
+# Prometheus text exposition (https://prometheus.io/docs/instrumenting/exposition_formats/)
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_LABELED = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$")
+
+
+def _prom_name(name: str) -> str:
+    name = _PROM_BAD.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _split_key(key: str) -> "tuple[str, Dict[str, str]]":
+    """``name{k=v,...}`` snapshot key → (base name, label dict)."""
+    m = _LABELED.match(key)
+    if not m:
+        return key, {}
+    labels: Dict[str, str] = {}
+    for part in m.group("labels").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[k.strip()] = v.strip()
+    return m.group("name"), labels
+
+
+def _prom_series(base: str, labels: Dict[str, str]) -> str:
+    name = _prom_name(base)
+    if not labels:
+        return name
+    inner = ",".join(
+        '%s="%s"' % (_prom_name(k), labels[k]) for k in sorted(labels)
+    )
+    return "%s{%s}" % (name, inner)
+
+
+def to_prometheus(snapshot: Dict) -> str:
+    """Render a flat snapshot (counters/gauges as numbers, timers as
+    their summary dicts) in the Prometheus text exposition format.
+    Timer summaries become ``<name>_ms{quantile=..}`` series plus
+    ``<name>_count`` / ``<name>_sum_ms``."""
+    lines: List[str] = []
+    for key in sorted(snapshot):
+        value = snapshot[key]
+        base, labels = _split_key(key)
+        if isinstance(value, dict):
+            stem = _prom_name(base) + "_ms"
+            lines.append("# TYPE %s summary" % stem)
+            for q, field in (("0.5", "p50_ms"), ("0.95", "p95_ms"), ("0.99", "p99_ms")):
+                ql = dict(labels)
+                ql["quantile"] = q
+                lines.append(
+                    "%s %s" % (_prom_series(base + "_ms", ql), value.get(field, 0.0))
+                )
+            lines.append(
+                "%s %s" % (_prom_series(base + "_count", labels), value.get("count", 0))
+            )
+            lines.append(
+                "%s %s" % (
+                    _prom_series(base + "_sum_ms", labels),
+                    round(value.get("mean_ms", 0.0) * value.get("count", 0), 3),
+                )
+            )
+        elif isinstance(value, bool):
+            lines.append("%s %d" % (_prom_series(base, labels), int(value)))
+        elif isinstance(value, (int, float)):
+            lines.append("%s %s" % (_prom_series(base, labels), value))
+        # non-numeric snapshot entries (strings) are skipped
+    return "\n".join(lines) + "\n"
+

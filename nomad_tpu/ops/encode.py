@@ -203,6 +203,11 @@ class RequestEncoder:
     def __init__(self, matrix: NodeMatrix):
         self.matrix = matrix
         self._cache: Dict[tuple, CompiledTaskGroup] = {}
+        # Cost attribution (ints under the GIL): a miss is a full
+        # constraint re-parse, the per-eval host tax the cache exists to
+        # avoid.  Surfaced as nomad.kernel.compile_cache{result=...}.
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def compile(
         self,
@@ -214,7 +219,9 @@ class RequestEncoder:
         key = (job.id, job.version, tg.name, algorithm, preemption_enabled)
         hit = self._cache.get(key)
         if hit is not None and self._guard_valid(hit):
+            self.cache_hits += 1
             return hit
+        self.cache_misses += 1
         compiled = self._compile(job, tg, algorithm, preemption_enabled)
         self._cache[key] = compiled
         return compiled

@@ -58,6 +58,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import trace
 from ..ops import kernels
 from ..ops.encode import SchedRequest
 from ..retry import env_int
@@ -117,6 +118,10 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     outcome: Optional[PlaceOutcome] = None
     error: Optional[BaseException] = None
+    # Trace context captured on the submitting worker's thread (place());
+    # the dispatch thread stitches coalescer.queue_wait onto it and the
+    # resolver thread stitches coalescer.device — the launch→resolver hop.
+    trace_ctx: Optional[trace.SpanContext] = None
 
 
 @dataclass
@@ -127,6 +132,7 @@ class _Ticket:
     packed: object
     entries: List[_Pending]
     matrix_version: int
+    launched_at: float = 0.0
 
 
 class DeviceCoalescer:
@@ -185,6 +191,11 @@ class DeviceCoalescer:
         self.coalesced_requests = 0
         self.stale_dispatches = 0
         self.inflight = 0
+        # Device cost attribution (surfaced as nomad.kernel.* gauges by
+        # the server): solo escape-hatch launches and host→device operand
+        # traffic staged per batched dispatch.
+        self.solo_ops = 0
+        self.operand_bytes_total = 0
         # TSan-lite (lint/tsan.py): lockset checking on the pending queue
         # and device-op list when a test enabled the sanitizer.
         from ..lint.tsan import maybe_instrument
@@ -251,6 +262,7 @@ class DeviceCoalescer:
             host_mask=host_mask,
             n_live=n_live,
             enqueued_at=time.time(),
+            trace_ctx=trace.current(),
         )
         with self._cond:
             if self._stop.is_set():
@@ -272,6 +284,7 @@ class DeviceCoalescer:
         oversized-delta selects): they still run on the one device thread
         instead of racing it on the tunnel."""
         op = _DeviceOp(fn=fn)
+        self.solo_ops += 1
         with self._cond:
             if self._stop.is_set():
                 raise RuntimeError("coalescer stopped")
@@ -299,18 +312,32 @@ class DeviceCoalescer:
             if not batch:
                 continue
             inject("coalescer.dispatch", lanes=len(batch))
+            trace.event("seam.coalescer.dispatch", lanes=len(batch))
             # Wait for a pipeline slot BEFORE launching: the permit bounds
             # overlapping latency windows (and how stale an in-flight read
             # can get).  Requests arriving during the wait coalesce into
             # the NEXT batch — the batch itself is already sealed.
             self._depth_sem.acquire()
+            waited = time.time()
             if self.metrics is not None:
-                waited = time.time()
                 qw = self.metrics.timer("nomad.coalescer.queue_wait")
                 for p in batch:
                     qw.observe(max(0.0, waited - p.enqueued_at))
+            # Stitch each lane's enqueue→launch wait onto its eval trace
+            # (carried here from the worker thread on _Pending.trace_ctx).
+            for p in batch:
+                if p.trace_ctx is not None:
+                    trace.record_span(
+                        "coalescer.queue_wait",
+                        p.enqueued_at,
+                        waited,
+                        ctx=p.trace_ctx,
+                        metrics=self.metrics,
+                    )
             try:
-                packed, version = self._dispatch(batch)
+                with trace.span("coalescer.launch", lanes=len(batch),
+                                metrics=self.metrics):
+                    packed, version = self._dispatch(batch)
             except BaseException as exc:  # noqa: BLE001
                 self._depth_sem.release()
                 for p in batch:
@@ -320,7 +347,9 @@ class DeviceCoalescer:
             self.dispatches += 1
             self.coalesced_requests += len(batch)
             self.inflight += 1
-            self._tickets.put(_Ticket(packed, batch, version))
+            self._tickets.put(
+                _Ticket(packed, batch, version, launched_at=waited)
+            )
 
     def _shutdown_pipeline(self) -> None:
         """Stop path: fail queued work, let the resolver drain in-flight
@@ -521,6 +550,12 @@ class DeviceCoalescer:
                 n_placements=self.scan_length,
                 live_counts=[p.n_live or self.scan_length for p in batch],
             )
+            self.operand_bytes_total += sum(
+                p.host_mask.nbytes + p.tg_count.nbytes + p.penalty.nbytes
+                + p.class_elig.nbytes + p.spread_counts.nbytes
+                + p.delta_rows.nbytes + p.delta_vals.nbytes
+                for p in batch
+            )
             lat = fake_device.latency_s()
             if lat > 0:
                 # Synthetic tunnel RTT: the fetch pays it, not the launch,
@@ -575,6 +610,14 @@ class DeviceCoalescer:
         reqs = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs), *req_lanes
         )
+        # Host→device operand traffic for this launch: the staged lane
+        # buffers plus the stacked request pytree (cost-attribution gauge;
+        # the resident matrix itself transfers via scatter, counted by
+        # matrix.upload_bytes_total).
+        self.operand_bytes_total += sum(a.nbytes for a in st.values()) + sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(reqs)
+            if hasattr(x, "nbytes")
+        )
         if n_shards > 1:
             return self._sharded_fn(
                 sharded, sharded.used, dr, dv, tg, sc, pen, reqs, ce, hm
@@ -600,15 +643,29 @@ class DeviceCoalescer:
                 p.error = exc
                 p.done.set()
             return
+        resolved_at = time.time()
+        # The launch→resolver hop: each lane's device window (launch to
+        # fetched-on-host) recorded here, on the resolver thread, against
+        # the trace context the worker thread captured in place().
+        for p in entries:
+            if p.trace_ctx is not None:
+                trace.record_span(
+                    "coalescer.device",
+                    ticket.launched_at or resolved_at,
+                    resolved_at,
+                    ctx=p.trace_ctx,
+                    metrics=self.metrics,
+                    lanes=len(entries),
+                )
         if self.matrix.version != ticket.matrix_version:
             # The matrix moved while this dispatch was in flight: its
             # placements were scored against a stale snapshot.  They are
             # still safe to propose — the serialized applier re-verifies
             # every plan against authoritative state — but the count is
-            # the pipelining tax worth watching.
+            # the pipelining tax worth watching (surfaced as a registry
+            # gauge over this attribute by the server).
             self.stale_dispatches += 1
-            if self.metrics is not None:
-                self.metrics.incr("nomad.coalescer.stale_dispatches")
+            trace.event("coalescer.stale_dispatch")
         for i, p in enumerate(entries):
             row = arr[i]
             p.outcome = PlaceOutcome(

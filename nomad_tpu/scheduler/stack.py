@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace
 from ..ops.encode import (
     CompiledTaskGroup,
     MAX_SPREAD_VALUES,
@@ -596,12 +597,13 @@ class GenericStack:
         start = time.monotonic()
 
         sched_cfg = self.ctx.snapshot.scheduler_config()
-        compiled = self.encoder.compile(
-            job,
-            tg,
-            algorithm=self.algorithm,
-            preemption_enabled=self.preemption_enabled,
-        )
+        with trace.span("sched.encode"):
+            compiled = self.encoder.compile(
+                job,
+                tg,
+                algorithm=self.algorithm,
+                preemption_enabled=self.preemption_enabled,
+            )
 
         n = self.matrix.capacity
 
@@ -616,8 +618,9 @@ class GenericStack:
             # read-only all-False mask instead of allocating per eval.
             penalty = self.matrix.shared_masks()[0]
 
-        class_elig = self._class_eligibility(compiled)
-        base_host_mask = self._host_mask(job, tg, compiled)
+        with trace.span("sched.feasibility"):
+            class_elig = self._class_eligibility(compiled)
+            base_host_mask = self._host_mask(job, tg, compiled)
         self._record_eligibility(class_elig, base_host_mask)
         if restrict_nodes is not None:
             allowed = np.zeros((n,), bool)
@@ -666,11 +669,14 @@ class GenericStack:
 
             spread_counts = self._spread_counts(job, tg, compiled)
 
-            (rows_all, scores_all, binpack_all, preempted_all, n_eval_all,
-             n_filt_all, n_exh_all) = self._dispatch_place(
-                compiled, deltas, tg_count, spread_counts, penalty,
-                class_elig, host_mask, remaining,
-            )
+            # Binpack + score are fused into the placement kernel, so one
+            # span covers the whole device dispatch (launch + result wait).
+            with trace.span("sched.dispatch", lanes=remaining):
+                (rows_all, scores_all, binpack_all, preempted_all, n_eval_all,
+                 n_filt_all, n_exh_all) = self._dispatch_place(
+                    compiled, deltas, tg_count, spread_counts, penalty,
+                    class_elig, host_mask, remaining,
+                )
             take = min(len(rows_all), remaining)
             rows_out = rows_all[:take]
             scores = scores_all[:take]
@@ -756,11 +762,13 @@ class SystemStack(GenericStack):
     def feasible_nodes(self, tg: TaskGroup) -> Tuple[List[str], AllocMetric]:
         assert self.job is not None
         job = self.job
-        compiled = self.encoder.compile(
-            job, tg, algorithm=self.algorithm, preemption_enabled=False
-        )
-        class_elig = self._class_eligibility(compiled)
-        host_mask = self._host_mask(job, tg, compiled)
+        with trace.span("sched.encode"):
+            compiled = self.encoder.compile(
+                job, tg, algorithm=self.algorithm, preemption_enabled=False
+            )
+        with trace.span("sched.feasibility"):
+            class_elig = self._class_eligibility(compiled)
+            host_mask = self._host_mask(job, tg, compiled)
         self._record_eligibility(class_elig, host_mask)
         n = self.matrix.capacity
 
@@ -804,7 +812,8 @@ class SystemStack(GenericStack):
                 ),
             ))
 
-        mf = self.matrix.run_on_device(dev_op)
+        with trace.span("sched.dispatch"):
+            mf = self.matrix.run_on_device(dev_op)
         mask, fits = mf[0], mf[1]
         ok = mask & fits
         metric = AllocMetric(

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import trace
 from ..structs.types import EvalStatus, Evaluation
 
 # Reference: nomad/config.go — EvalNackTimeout / EvalDeliveryLimit defaults.
@@ -75,7 +76,9 @@ class EvalBroker:
         self,
         nack_timeout: float = DEFAULT_NACK_TIMEOUT,
         delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+        metrics=None,
     ):
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self.nack_timeout = nack_timeout
@@ -95,6 +98,10 @@ class EvalBroker:
         self._seq = itertools.count()
         # Delay heap for wait_until evals.
         self._delayed: List[Tuple[float, int, Evaluation]] = []
+        # Ready-queue entry timestamps for the broker.queue_wait trace
+        # span — broker-owned (Evaluation.copy() rebuilds from __dict__,
+        # so the eval struct itself cannot carry dynamic attributes).
+        self._enqueue_ts: Dict[str, float] = {}
         # Evals enqueued while disabled (flushed on enable).
         self._deferred: List[Evaluation] = []
         self._shutdown = False
@@ -146,6 +153,7 @@ class EvalBroker:
         self._pending.clear()
         self._delayed = []
         self._tracked.clear()
+        self._enqueue_ts.clear()
 
     @property
     def enabled(self) -> bool:
@@ -180,6 +188,9 @@ class EvalBroker:
         self._enqueue_ready_locked(ev)
 
     def _enqueue_ready_locked(self, ev: Evaluation) -> None:
+        # Queue-wait starts at first readiness (per-job pending keeps its
+        # original stamp; a nack redelivery re-stamps from requeue).
+        self._enqueue_ts.setdefault(ev.id, time.time())
         key = (ev.namespace, ev.job_id)
         holder = self._job_tokens.get(key)
         if holder is not None and holder != ev.id and ev.job_id:
@@ -218,7 +229,8 @@ class EvalBroker:
                         self._unack[ev.id] = _Unack(
                             ev, token, time.time() + self.nack_timeout
                         )
-                        return ev, token
+                        enq_ts = self._enqueue_ts.pop(ev.id, None)
+                        break
                 # Expired-nack requeues are the watcher thread's job (it
                 # notifies when it moves anything), so waiters here sleep
                 # for their full remaining timeout instead of 1s-capped
@@ -229,6 +241,20 @@ class EvalBroker:
                     if wait <= 0:
                         return None, ""
                 self._cond.wait(timeout=wait)
+        # Outside the broker lock: stitch the enqueue→dequeue wait into the
+        # eval's trace (trace id == eval id, so the worker's root span joins
+        # the same trace without any handoff through the eval struct).
+        if enq_ts is not None:
+            trace.record_span(
+                "broker.queue_wait",
+                enq_ts,
+                time.time(),
+                ctx=trace.start_trace(ev.id),
+                parent=0,
+                metrics=self.metrics,
+                attempt=count,
+            )
+        return ev, token
 
     def _pop_ready_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
         # Highest priority across the requested queues (DequeueEval scan).
@@ -253,6 +279,7 @@ class EvalBroker:
             del self._unack[eval_id]
             self._attempts.pop(eval_id, None)
             self._tracked.discard(eval_id)
+            self._enqueue_ts.pop(eval_id, None)
             ev = un.eval
             key = (ev.namespace, ev.job_id)
             if self._job_tokens.get(key) == ev.id:
@@ -373,6 +400,7 @@ class EvalBroker:
                         break
                     out.append(ev)
                     self._tracked.discard(ev.id)
+                    self._enqueue_ts.pop(ev.id, None)
                     key = (ev.namespace, ev.job_id)
                     if self._job_tokens.get(key) == ev.id:
                         del self._job_tokens[key]

@@ -21,6 +21,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 import time
 
+from .. import trace
 from ..chaos import inject
 
 
@@ -73,6 +74,7 @@ class HeartbeatManager:
         # side), so a client heartbeating "on time" by its own clock still
         # expires — the failure mode of drifted hosts.
         fault = inject("heartbeat.ttl", node=node_id)
+        trace.event("seam.heartbeat.ttl", node=node_id)
         skew = (
             fault.duration
             if fault is not None and fault.kind == "skew" and fault.duration

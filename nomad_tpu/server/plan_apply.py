@@ -20,9 +20,12 @@ holding the store lock (a tunnel round-trip costs ~65ms).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from .. import trace
 
 from ..structs.types import (
     Allocation,
@@ -95,15 +98,40 @@ class PlanApplier:
             return
 
         outcomes = []
+        apply_t0 = time.time()
+        spans: List[Tuple[PendingPlan, float, float]] = []
         with self.server.metrics.timer("nomad.plan.apply").time():
             with store._write_lock:
                 with store._lock:
                     for pending in staged:
+                        t0 = time.time()
                         try:
                             result, index = self._apply_locked(pending.plan)
                             outcomes.append((pending, result, index, None))
                         except Exception as exc:  # noqa: BLE001
                             outcomes.append((pending, None, 0, exc))
+                        spans.append((pending, t0, time.time()))
+        # Trace stitching happens after the store locks are released —
+        # per-plan timestamps were collected inside, recorded here onto
+        # each plan's carried worker context.
+        for pending, t0, t1 in spans:
+            if pending.trace_ctx is None:
+                continue
+            trace.record_span(
+                "plan.queue_wait",
+                pending.enqueued_at,
+                apply_t0,
+                ctx=pending.trace_ctx,
+                metrics=self.server.metrics,
+            )
+            trace.record_span(
+                "plan.apply",
+                t0,
+                t1,
+                ctx=pending.trace_ctx,
+                metrics=self.server.metrics,
+                eval=pending.plan.eval_id,
+            )
         for pending, result, index, exc in outcomes:
             if exc is not None:
                 pending.respond(None, exc)

@@ -10,8 +10,10 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from typing import List, Optional, Tuple
 
+from .. import trace
 from ..structs.types import Plan, PlanResult
 
 
@@ -23,6 +25,11 @@ class PendingPlan:
         self._event = threading.Event()
         self._result: Optional[PlanResult] = None
         self._error: Optional[Exception] = None
+        # Trace context captured on the submitting worker's thread; the
+        # applier thread stitches plan.queue_wait / plan.apply spans onto
+        # it (the plan's hop across the worker→applier boundary).
+        self.trace_ctx = trace.current()
+        self.enqueued_at = time.time()
 
     def respond(self, result: Optional[PlanResult], error: Optional[Exception]) -> None:
         self._result = result

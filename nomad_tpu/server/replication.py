@@ -44,6 +44,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from .. import trace
 from ..chaos import inject
 from ..retry import Backoff, RetryPolicy, env_float
 
@@ -290,6 +291,7 @@ class Replicator:
         # elections.  "dup" replays an entry append (the PrevSeq check on
         # the receiver must reject the stale duplicate).
         fault = inject("raft.send", path=path, src=self.id, dst=addr)
+        trace.event("seam.raft.send", path=path, dst=addr)
         if fault is not None and fault.kind == "drop":
             raise urllib.error.URLError("injected partition")
         data = json.dumps(payload).encode()

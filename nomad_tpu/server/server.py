@@ -121,6 +121,7 @@ class Server:
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.eval_nack_timeout,
             delivery_limit=self.config.eval_delivery_limit,
+            metrics=self.metrics,
         )
         self.blocked_evals = BlockedEvals(self.eval_broker.enqueue)
         self.plan_queue = PlanQueue()
@@ -150,6 +151,14 @@ class Server:
         )
         self.matrix.coalescer = self.coalescer
 
+        # Ambient trace spans (scheduler stack has no server handle) feed
+        # this server's phase histograms; last server constructed wins,
+        # which only blurs attribution in multi-server tests.
+        from .. import trace
+
+        trace.set_default_metrics(self.metrics)
+        self._register_telemetry_gauges()
+
         self._index_lock = threading.Lock()
         self._index = 0
         self._last_gc = time.time()
@@ -158,6 +167,55 @@ class Server:
         self._shutdown = threading.Event()
         self.replicator = None  # set by setup_replication (multi-server)
         self._acl_cache: Dict = {}
+
+    def _register_telemetry_gauges(self) -> None:
+        """Unify the scattered matrix/coalescer/encoder counters into the
+        registry as pull gauges — one snapshot carries the whole device
+        cost-attribution picture (ISSUE 9).  The legacy flat names the
+        agent's /v1/metrics handler used to hand-roll are preserved."""
+        m = self.metrics
+        c = self.coalescer
+        mx = self.matrix
+        enc = mx.shared_encoder()
+        # Legacy names (pre-registry hand-rolled dict in api/agent.py).
+        m.gauge_fn("nomad.coalescer.pipeline_depth", lambda: c.pipeline_depth)
+        m.gauge_fn("nomad.coalescer.inflight_depth", c.inflight_depth)
+        m.gauge_fn("nomad.coalescer.dispatches", lambda: c.dispatches)
+        m.gauge_fn(
+            "nomad.coalescer.coalesced_requests", lambda: c.coalesced_requests
+        )
+        m.gauge_fn(
+            "nomad.coalescer.lane_fill_ratio",
+            lambda: round(
+                c.coalesced_requests / (c.dispatches * c.max_lanes or 1), 4
+            ),
+        )
+        m.gauge_fn("nomad.coalescer.stale_dispatches", lambda: c.stale_dispatches)
+        m.gauge_fn("nomad.matrix.full_uploads", lambda: mx.full_uploads)
+        m.gauge_fn("nomad.matrix.scatter_syncs", lambda: mx.scatter_syncs)
+        m.gauge_fn(
+            "nomad.matrix.rows_scattered_total", lambda: mx.rows_scattered_total
+        )
+        m.gauge_fn(
+            "nomad.matrix.rows_per_scatter",
+            lambda: round(mx.rows_scattered_total / (mx.scatter_syncs or 1), 2),
+        )
+        m.gauge_fn(
+            "nomad.matrix.upload_bytes_total", lambda: mx.upload_bytes_total
+        )
+        # Per-kernel attribution: launch counts by path, request
+        # compile-cache hit/miss, and host→device operand traffic.
+        m.gauge_fn("nomad.kernel.launches", lambda: c.dispatches, path="batched")
+        m.gauge_fn("nomad.kernel.launches", lambda: c.solo_ops, path="solo")
+        m.gauge_fn(
+            "nomad.kernel.compile_cache", lambda: enc.cache_hits, result="hit"
+        )
+        m.gauge_fn(
+            "nomad.kernel.compile_cache", lambda: enc.cache_misses, result="miss"
+        )
+        m.gauge_fn(
+            "nomad.kernel.operand_bytes_total", lambda: c.operand_bytes_total
+        )
 
     # ------------------------------------------------------------------
     # Consensus (server/replication.py)

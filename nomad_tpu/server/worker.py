@@ -16,6 +16,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from .. import trace
 from ..scheduler import new_scheduler
 from ..state.store import StateSnapshot
 from ..structs.types import Evaluation, Plan, PlanResult
@@ -71,20 +72,30 @@ class Worker:
             )
             if ev is None:
                 continue
-            try:
-                self.process_eval(ev, token)
-            except Exception:  # noqa: BLE001
-                log.exception("scheduler failed for eval %s", ev.id)
+            # Root span of the eval's trace (trace id == eval id; the
+            # broker's queue_wait span recorded at dequeue shares it).
+            with trace.span(
+                "eval.process",
+                trace_id=ev.id,
+                metrics=self.server.metrics,
+                type=ev.type,
+            ):
                 try:
-                    self.server.eval_broker.nack(ev.id, token)
+                    self.process_eval(ev, token)
+                except Exception:  # noqa: BLE001
+                    log.exception("scheduler failed for eval %s", ev.id)
+                    try:
+                        self.server.eval_broker.nack(ev.id, token)
+                    except ValueError:
+                        pass
+                    trace.event("eval.nack")
+                    continue
+                try:
+                    self.server.eval_broker.ack(ev.id, token)
                 except ValueError:
                     pass
-                continue
-            try:
-                self.server.eval_broker.ack(ev.id, token)
-            except ValueError:
-                pass
-            self.evals_processed += 1
+                trace.event("eval.ack")
+                self.evals_processed += 1
 
     def process_eval(self, ev: Evaluation, token: str = "") -> None:
         # The delivery token rides on the eval; schedulers stamp it into
@@ -94,14 +105,16 @@ class Worker:
         metrics = self.server.metrics
         # ★ sync point: local replica must reach the eval's creation index
         # before scheduling (worker.go:121, snapshotMinIndex).
-        with metrics.timer("nomad.worker.wait_for_index").time():
+        with trace.span("worker.wait_for_index", metrics=metrics), \
+                metrics.timer("nomad.worker.wait_for_index").time():
             self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
         self._snapshot = self.server.store.snapshot()
         sched = new_scheduler(
             ev.type, self._snapshot, self, self.server.store.matrix
         )
         # invoke_scheduler timer (worker.go:245) — the per-eval hot path.
-        with metrics.timer("nomad.worker.invoke_scheduler").time():
+        with trace.span("worker.invoke_scheduler", metrics=metrics), \
+                metrics.timer("nomad.worker.invoke_scheduler").time():
             sched.process(ev)
         if ev.create_time:
             # Enqueue→scheduled end-to-end latency (eval_broker telemetry).
@@ -116,11 +129,12 @@ class Worker:
     def submit_plan(
         self, plan: Plan
     ) -> Tuple[Optional[PlanResult], Optional[StateSnapshot]]:
-        pending = self.server.plan_queue.enqueue(plan)
-        try:
-            result = pending.wait(timeout=PLAN_APPLY_TIMEOUT)
-        except Exception:  # noqa: BLE001 — queue disabled / apply error
-            return None, self.server.store.snapshot()
+        with trace.span("plan.submit", metrics=self.server.metrics):
+            pending = self.server.plan_queue.enqueue(plan)
+            try:
+                result = pending.wait(timeout=PLAN_APPLY_TIMEOUT)
+            except Exception:  # noqa: BLE001 — queue disabled / apply error
+                return None, self.server.store.snapshot()
         snapshot = None
         if result.refresh_index:
             # Partial commit: catch up to the refresh index before retrying

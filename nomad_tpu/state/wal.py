@@ -37,6 +37,7 @@ import json
 import os
 from typing import Any, Iterator, List, Optional, Tuple
 
+from .. import trace
 from ..chaos import inject
 
 LOG_NAME = "wal.jsonl"
@@ -155,6 +156,7 @@ class WriteAheadLog:
             # append).  The owning process must restart and re-load.
             raise WALWriteError("log poisoned by earlier torn write")
         fault = inject("wal.write", op=entry.get("op", ""))
+        trace.event("seam.wal.write", op=entry.get("op", ""))
         if fault is not None and fault.kind == "torn":
             fh.write(line[: max(1, len(line) // 2)])
             fh.flush()

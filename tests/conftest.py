@@ -60,6 +60,24 @@ def pytest_configure(config):
 nomad_tpu.enable_compilation_cache("/root/repo/.jax_cache")
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.when == "call" and report.failed:
+        # Post-mortem: persist the flight recorder (span ring buffers +
+        # active chaos seed) so the failed run's timeline survives.
+        # Capped per process (trace._MAX_AUTO_DUMPS) so a cascading
+        # failure doesn't flood the trace dir.
+        from nomad_tpu import trace
+
+        path = trace.auto_dump("test-failure", extra={"test": item.nodeid})
+        if path:
+            report.sections.append(
+                ("flight record", f"span timeline dumped to {path}")
+            )
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

@@ -13,7 +13,9 @@ import textwrap
 import threading
 
 from nomad_tpu.lint import Baseline, Finding, load_baseline, split_baselined
-from nomad_tpu.lint import chaospass, jaxpass, lockpass, tsan
+from nomad_tpu.lint import chaospass, jaxpass, lockpass, obspass, tsan
+
+_dedent = textwrap.dedent
 
 
 def _lock_findings(src: str, path: str = "nomad_tpu/state/matrix.py"):
@@ -419,6 +421,94 @@ class TestChaosPass:
             seams, retry_mods = chaospass.parse_doc(fh.read())
         assert "rpc.call" in seams and "raft.send" in seams
         assert any(m.endswith("rpc.py") for m in retry_mods)
+
+
+# ----------------------------------------------------------------------
+# Observability pass (O001)
+# ----------------------------------------------------------------------
+
+class TestObsPass:
+    def test_seam_without_trace_fires_o001(self):
+        fs = obspass.analyze_module("nomad_tpu/m.py", _dedent('''
+            from ..chaos import inject
+
+            def hot_path():
+                fault = inject("wal.write", op="x")
+                return fault
+        '''))
+        assert len(fs) == 1 and fs[0].rule == "O001", fs
+        assert fs[0].symbol == "hot_path"
+        assert "wal.write" in fs[0].message
+
+    def test_direct_emission_is_clean(self):
+        fs = obspass.analyze_module("nomad_tpu/m.py", _dedent('''
+            from .. import trace
+            from ..chaos import inject
+
+            def hot_path():
+                fault = inject("wal.write", op="x")
+                trace.event("seam.wal.write", op="x")
+        '''))
+        assert fs == [], fs
+
+    def test_span_counts_as_emission(self):
+        fs = obspass.analyze_module("nomad_tpu/m.py", _dedent('''
+            from .. import trace
+            from ..chaos import inject
+
+            def hot_path():
+                inject("rpc.call", path="/x")
+                with trace.span("rpc.send"):
+                    pass
+        '''))
+        assert fs == [], fs
+
+    def test_emitting_wrapper_covers_callers(self):
+        # driver.py's pattern: a local _chaos guard emits the event for
+        # every caller, so call sites need no trace call of their own.
+        fs = obspass.analyze_module("nomad_tpu/m.py", _dedent('''
+            from .. import trace
+            from ..chaos import inject
+
+            def _chaos(point, **kw):
+                f = inject(point, **kw)
+                trace.event("seam." + point, **kw)
+                return f
+
+            def start_task():
+                _chaos("driver.start", driver="d")
+        '''))
+        assert fs == [], fs
+
+    def test_silent_wrapper_flags_callers(self):
+        fs = obspass.analyze_module("nomad_tpu/m.py", _dedent('''
+            from ..chaos import inject
+
+            def _chaos(point, **kw):
+                return inject(point, **kw)
+
+            def start_task():
+                _chaos("driver.start", driver="d")
+        '''))
+        assert any(f.symbol == "start_task" for f in fs), fs
+
+    def test_nested_def_does_not_leak_emission(self):
+        # A trace call inside an inner closure is not on the seam's path.
+        fs = obspass.analyze_module("nomad_tpu/m.py", _dedent('''
+            from .. import trace
+            from ..chaos import inject
+
+            def outer():
+                inject("wal.write", op="x")
+                def unrelated():
+                    trace.event("elsewhere")
+        '''))
+        assert len(fs) == 1 and fs[0].symbol == "outer", fs
+
+    def test_production_tree_is_clean(self):
+        from nomad_tpu.lint import repo_root
+
+        assert obspass.run(repo_root()) == []
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,117 @@
+"""Metrics registry units: percentile definition, empty-timer edge,
+labeled counters, pull gauges, and the Prometheus text exposition."""
+
+from __future__ import annotations
+
+from nomad_tpu.metrics import (
+    MetricsRegistry,
+    Timer,
+    labeled,
+    to_prometheus,
+)
+
+
+class TestTimerPercentiles:
+    def test_ceil_rank_p99_of_100(self):
+        # Nearest-rank: p99 of 1..100 ms is the 99th sample, 99 ms —
+        # the old int(q*n) floor produced 100 ms only via the clamp.
+        t = Timer()
+        for i in range(1, 101):
+            t.observe(i / 1000.0)
+        snap = t.snapshot()
+        assert snap["p99_ms"] == 99.0, snap
+        assert snap["p95_ms"] == 95.0, snap
+        assert snap["p50_ms"] == 50.0, snap
+
+    def test_small_reservoirs(self):
+        t = Timer()
+        for i in (1, 2, 3):
+            t.observe(i / 1000.0)
+        snap = t.snapshot()
+        # ceil(0.5*3)=2nd sample; ceil(0.99*3)=3rd sample
+        assert snap["p50_ms"] == 2.0
+        assert snap["p99_ms"] == 3.0
+
+    def test_single_sample_is_every_percentile(self):
+        t = Timer()
+        t.observe(0.007)
+        snap = t.snapshot()
+        assert snap["p50_ms"] == snap["p99_ms"] == 7.0
+
+    def test_empty_timer_min_is_zero(self):
+        # Regression: an untouched Timer reported min_ms=inf (the
+        # sentinel leaked into the snapshot and broke JSON consumers).
+        snap = Timer().snapshot()
+        assert snap["min_ms"] == 0.0
+        assert snap["count"] == 0
+        assert snap["mean_ms"] == 0.0
+        assert snap["p99_ms"] == 0.0
+
+    def test_min_max_track_extremes(self):
+        t = Timer()
+        for s in (0.005, 0.001, 0.009):
+            t.observe(s)
+        snap = t.snapshot()
+        assert snap["min_ms"] == 1.0
+        assert snap["max_ms"] == 9.0
+
+
+class TestLabeledCounters:
+    def test_label_key_is_stable_and_sorted(self):
+        assert labeled("x.y") == "x.y"
+        assert labeled("x.y", b="2", a="1") == "x.y{a=1,b=2}"
+
+    def test_incr_with_labels_keeps_series_separate(self):
+        reg = MetricsRegistry()
+        reg.incr("nomad.kernel.launches", path="batched")
+        reg.incr("nomad.kernel.launches", path="batched")
+        reg.incr("nomad.kernel.launches", path="solo")
+        snap = reg.snapshot()
+        assert snap["nomad.kernel.launches{path=batched}"] == 2
+        assert snap["nomad.kernel.launches{path=solo}"] == 1
+
+    def test_gauge_fn_polled_at_snapshot(self):
+        reg = MetricsRegistry()
+        box = {"v": 3}
+        reg.gauge_fn("nomad.depth", lambda: box["v"])
+        assert reg.snapshot()["nomad.depth"] == 3
+        box["v"] = 9
+        assert reg.snapshot()["nomad.depth"] == 9
+
+    def test_broken_gauge_reports_zero(self):
+        # A gauge over a torn-down object must not break /v1/metrics.
+        reg = MetricsRegistry()
+        reg.gauge_fn("nomad.gone", lambda: 1 / 0)
+        assert reg.snapshot()["nomad.gone"] == 0
+
+
+class TestPrometheusExposition:
+    def test_counters_and_labels(self):
+        reg = MetricsRegistry()
+        reg.incr("nomad.kernel.launches", by=7, path="batched")
+        reg.incr("uptime_s", by=3)
+        text = to_prometheus(reg.snapshot())
+        assert 'nomad_kernel_launches{path="batched"} 7' in text
+        assert "uptime_s 3" in text
+
+    def test_timer_renders_as_summary(self):
+        reg = MetricsRegistry()
+        t = reg.timer("nomad.plan.apply")
+        for i in range(1, 11):
+            t.observe(i / 1000.0)
+        text = to_prometheus(reg.snapshot())
+        assert "# TYPE nomad_plan_apply_ms summary" in text
+        assert 'nomad_plan_apply_ms{quantile="0.99"} 10.0' in text
+        assert "nomad_plan_apply_count 10" in text
+        assert "nomad_plan_apply_sum_ms 55.0" in text
+
+    def test_bad_chars_sanitized(self):
+        reg = MetricsRegistry()
+        reg.incr("client.allocs-running")
+        text = to_prometheus(reg.snapshot())
+        assert "client_allocs_running 1" in text
+
+    def test_non_numeric_entries_skipped(self):
+        text = to_prometheus({"version": "1.2.3", "n": 1})
+        assert "version" not in text
+        assert "n 1" in text

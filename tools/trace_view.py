@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Summarize a nomad-tpu trace dump (Chrome trace-event JSON) in the
+terminal — the quick look before loading it into Perfetto.
+
+Usage:
+    nomad-tpu trace dump -o trace.json      # or any flight-*.json dump
+    python tools/trace_view.py trace.json
+    python tools/trace_view.py trace.json --trace eval-abc123
+
+Per-phase table: span count, total/mean/max duration, share of the
+summed root-span time.  With ``--trace ID`` prints that eval's span
+tree with per-span durations instead.
+
+For the full timeline, load the same file in https://ui.perfetto.dev
+(drag the file into the page) — spans are grouped per thread with
+trace/span/parent ids in the args pane.
+
+Stdlib-only on purpose: works on any host that can scp the dump over.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+    else:
+        events = doc  # bare-array variant is also legal Chrome format
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def summarize(events: List[Dict[str, Any]]) -> None:
+    by_name: Dict[str, List[float]] = defaultdict(list)
+    roots = 0.0
+    for e in events:
+        dur_ms = e.get("dur", 0) / 1000.0
+        by_name[e["name"]].append(dur_ms)
+        if not e.get("args", {}).get("parent"):
+            roots += dur_ms
+    if not by_name:
+        print("no complete spans in file")
+        return
+    rows = []
+    for name, durs in sorted(by_name.items()):
+        total = sum(durs)
+        rows.append((
+            name, len(durs), total, total / len(durs), max(durs),
+            100.0 * total / roots if roots else 0.0,
+        ))
+    rows.sort(key=lambda r: -r[2])
+    hdr = f"{'phase':<28}{'count':>7}{'total ms':>11}{'mean ms':>10}" \
+          f"{'max ms':>10}{'% root':>8}"
+    print(hdr)
+    print("-" * len(hdr))
+    for name, n, total, mean, mx, pct in rows:
+        print(f"{name:<28}{n:>7}{total:>11.2f}{mean:>10.3f}"
+              f"{mx:>10.3f}{pct:>8.1f}")
+    print(f"\n{len(events)} spans; summed root-span time {roots:.2f} ms")
+    print("full timeline: load this file in https://ui.perfetto.dev")
+
+
+def show_trace(events: List[Dict[str, Any]], trace_id: str) -> None:
+    mine = [e for e in events
+            if e.get("args", {}).get("trace") == trace_id]
+    if not mine:
+        print(f"no spans for trace {trace_id!r}", file=sys.stderr)
+        sys.exit(1)
+    by_parent: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+    for e in mine:
+        by_parent[e["args"].get("parent") or 0].append(e)
+    for kids in by_parent.values():
+        kids.sort(key=lambda e: e.get("ts", 0))
+    t0 = min(e["ts"] for e in mine)
+
+    def walk(parent: Any, depth: int) -> None:
+        for e in by_parent.get(parent, ()):
+            off = (e["ts"] - t0) / 1000.0
+            dur = e.get("dur", 0) / 1000.0
+            print(f"{'  ' * depth}{e['name']:<{30 - 2 * depth}}"
+                  f" +{off:8.3f} ms  {dur:8.3f} ms")
+            walk(e["args"].get("span"), depth + 1)
+
+    print(f"trace {trace_id} ({len(mine)} spans)")
+    walk(0, 0)
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="Chrome trace JSON (trace dump or "
+                                 "flight-*.json)")
+    ap.add_argument("--trace", default="",
+                    help="print one trace's span tree instead")
+    args = ap.parse_args(argv)
+    events = load_events(args.path)
+    if args.trace:
+        show_trace(events, args.trace)
+    else:
+        summarize(events)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
