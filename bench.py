@@ -889,6 +889,28 @@ def main() -> None:
             result["live_pipeline_error"] = f"{type(e).__name__}: {e}"
     result["total_s"] = round(time.time() - t_setup, 1)
     print(json.dumps(result))
+    # Regression ledger: append this run to BENCH_LEDGER.jsonl and print
+    # improve/flat/regress verdicts vs the rolling baseline (stderr, so
+    # the stdout JSON-line contract above stays parseable).
+    # NOMAD_TPU_BENCH_LEDGER redirects the ledger (tests point it at a
+    # tmp file so toy-cluster smokes don't pollute the committed
+    # baselines); "0"/"off" disables the hook entirely.
+    ledger_env = os.environ.get("NOMAD_TPU_BENCH_LEDGER", "")
+    if ledger_env.lower() in ("0", "off", "no"):
+        return
+    try:
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools"))
+        import bench_history
+
+        kw = {"ledger": ledger_env} if ledger_env else {}
+        entry = bench_history.record_run(result, source="bench.py", **kw)
+        for line in bench_history.format_verdicts(entry):
+            print(line, file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the ledger must never cost a run
+        print(f"bench ledger skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

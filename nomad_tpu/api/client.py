@@ -383,6 +383,14 @@ class APIClient:
         """Prometheus text exposition of the agent's metrics registry."""
         return self._call_raw("GET", "/v1/metrics?format=prometheus").decode()
 
+    def slo(self) -> Dict:
+        """SLO observatory report: per-spec value, burn rates, status."""
+        return self._call("GET", "/v1/slo")
+
+    def health(self) -> Dict:
+        """Composite health: status band, score, pressure inputs."""
+        return self._call("GET", "/v1/health")
+
     # Tracing -----------------------------------------------------------
 
     def trace_records(

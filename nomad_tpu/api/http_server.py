@@ -1585,6 +1585,22 @@ class HTTPAPIServer:
                 store.set_scheduler_config(server.next_index(), new)
                 return {"Updated": True}
 
+        if path == "/v1/slo" and method == "GET":
+            server = self.agent.server
+            if server is None:
+                raise HTTPError(501, "agent is not running a server")
+            return server.observatory.slo_report()
+
+        if path == "/v1/health" and method == "GET":
+            # Liveness + overload surface: status/score/pressure inputs
+            # plus currently breached SLOs (obs/health.py).  Always 200 —
+            # the status field is the verdict, so a degraded cluster
+            # still serves its own diagnosis.
+            server = self.agent.server
+            if server is None:
+                raise HTTPError(501, "agent is not running a server")
+            return server.observatory.health_report()
+
         if path == "/v1/metrics" and method == "GET":
             snap = self.agent.metrics()
             if query.get("format") == "prometheus":

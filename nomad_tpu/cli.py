@@ -704,6 +704,26 @@ def _watch_metrics(client, args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    from .obs.top import run_top
+
+    return run_top(
+        _client(args),
+        interval=args.interval,
+        count=args.count,
+        clear=not args.no_clear,
+    )
+
+
+def cmd_slo(args) -> int:
+    client = _client(args)
+    if args.health:
+        _print(client.health())
+    else:
+        _print(client.slo())
+    return 0
+
+
 def cmd_trace_dump(args) -> int:
     body = _client(args).trace_dump(limit=args.limit)
     if args.output:
@@ -998,6 +1018,21 @@ def build_parser() -> argparse.ArgumentParser:
     metrics.add_argument("--count", type=int, default=0,
                          help="stop after N delta rounds (0 = forever)")
     metrics.set_defaults(fn=cmd_metrics)
+
+    top = sub.add_parser("top", help="live cluster dashboard (evals/s, "
+                         "phase latencies, queues, SLO burn rates)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh period in seconds")
+    top.add_argument("--count", type=int, default=0,
+                     help="render N frames then exit (0 = until ^C)")
+    top.add_argument("--no-clear", action="store_true",
+                     help="append frames instead of clearing the screen")
+    top.set_defaults(fn=cmd_top)
+
+    slo = sub.add_parser("slo", help="SLO report (burn rates, status)")
+    slo.add_argument("--health", action="store_true",
+                     help="show the composite health report instead")
+    slo.set_defaults(fn=cmd_slo)
 
     tr = sub.add_parser("trace", help="eval-lifecycle tracing").add_subparsers(
         dest="trace_cmd", required=True

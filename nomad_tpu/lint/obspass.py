@@ -1,4 +1,4 @@
-"""Observability pass (rule O001).
+"""Observability pass (rules O001, O002).
 
 The flight recorder is only as good as its coverage: a chaos seam that
 fires without leaving a trace event is invisible in the post-mortem
@@ -11,6 +11,20 @@ emit a trace event on the same path** — and this pass enforces it:
   calls ``trace.event``/``trace.span``/``trace.record_span``, and whose
   injector function is not a module-local wrapper that emits the event
   itself (driver.py's ``_chaos`` pattern).
+
+* **O002 SLO objective is not a registered metric** — an
+  ``SLOSpec(...)`` call site whose literal ``objective=`` string does
+  not resolve to any metric name the codebase registers.  A renamed
+  timer would otherwise silently turn the SLO into a constant (never
+  sampled, never breached, forever ``pending``).  "Registered" means
+  any of: a literal first argument to ``timer(...)``/``incr(...)``/
+  ``gauge_fn(...)``/``set_gauge(...)``; ``nomad.phase.<name>`` for a
+  literal ``span(...)``/``record_span(...)`` name (trace spans feed
+  phase timers); or a literal ``nomad.*`` string used as a dict-store
+  key (the agent/observatory hand-rolled snapshot pattern).  The name
+  set is collected from the whole tree, so the check is a ``run``-level
+  pass; :func:`collect_metric_names` + :func:`analyze_slo_objectives`
+  expose the two halves for fixtures.
 
 Shares the seam-site discovery with :mod:`.chaospass` (same
 ``INJECT_FUNC_NAMES``, same tree walk) so the two passes can't drift
@@ -125,8 +139,106 @@ def analyze_module(rel: str, src: str) -> List[Finding]:
     return findings
 
 
-def run(root: str) -> List[Finding]:
+# -- O002: SLO objectives must resolve to registered metrics -----------
+
+# Calls whose literal first string argument registers a metric name.
+METRIC_REG_NAMES = frozenset({"timer", "incr", "gauge_fn", "set_gauge"})
+# Calls whose literal first string argument names a trace span — spans
+# feed `nomad.phase.<name>` timers via trace.record_span.
+SPAN_REG_NAMES = frozenset({"span", "record_span"})
+
+
+def _first_str_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    return None
+
+
+def collect_metric_names(src: str) -> Set[str]:
+    """Every metric name this module registers (see O002 docstring)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return set()
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = _call_name(node)
+            first = _first_str_arg(node)
+            if first is None:
+                continue
+            if fname in METRIC_REG_NAMES:
+                names.add(first)
+            elif fname in SPAN_REG_NAMES:
+                names.add("nomad.phase." + first)
+        elif isinstance(node, ast.Assign):
+            # snap["nomad.broker.total_ready"] = ... — the hand-rolled
+            # snapshot keys in api/agent.py and obs/evaluator.py.
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)
+                    and tgt.slice.value.startswith("nomad.")
+                ):
+                    names.add(tgt.slice.value)
+    return names
+
+
+def _slo_objectives(src: str) -> List[Tuple[str, str, int]]:
+    """(slo name, literal objective, line) for every SLOSpec(...) call
+    whose objective is a string literal (keyword or 2nd positional)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _call_name(node) == "SLOSpec"):
+            continue
+        objective = None
+        slo_name = "?"
+        for kw in node.keywords:
+            if kw.arg == "objective" and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, str):
+                objective = kw.value.value
+            if kw.arg == "name" and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, str):
+                slo_name = kw.value.value
+        if objective is None and len(node.args) >= 2:
+            a = node.args[1]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                objective = a.value
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                slo_name = a0.value
+        if objective is not None:
+            out.append((slo_name, objective, node.lineno))
+    return out
+
+
+def analyze_slo_objectives(
+    rel: str, src: str, registered: Set[str]
+) -> List[Finding]:
+    """Pure O002 check of one module against a known name set."""
     findings: List[Finding] = []
+    for slo_name, objective, line in _slo_objectives(src):
+        if objective in registered:
+            continue
+        findings.append(Finding(
+            "O002", rel, line, slo_name,
+            f"SLO `{slo_name}` objective `{objective}` does not resolve "
+            f"to any registered metric (timer/incr/gauge_fn/set_gauge, "
+            f"trace span, or snapshot key) — the SLO would never sample",
+        ))
+    return findings
+
+
+def _walk_sources(root: str):
     pkg = os.path.join(root, "nomad_tpu")
     for dirpath, dirnames, filenames in os.walk(pkg):
         dirnames[:] = [d for d in dirnames if d not in ("__pycache__", "lint")]
@@ -135,9 +247,22 @@ def run(root: str) -> List[Finding]:
                 continue
             p = os.path.join(dirpath, fn)
             rel = os.path.relpath(p, root).replace(os.sep, "/")
-            if rel.endswith(_SKIP_FILES):
-                continue
             with open(p) as fh:
                 src = fh.read()
+            yield rel, src
+
+
+def run(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    # Phase 1: collect the registered-metric universe (all modules,
+    # including the O001-skipped ones — they still register metrics).
+    registered: Set[str] = set()
+    sources = list(_walk_sources(root))
+    for _rel, src in sources:
+        registered |= collect_metric_names(src)
+    # Phase 2: per-module rules.
+    for rel, src in sources:
+        if not rel.endswith(_SKIP_FILES):
             findings.extend(analyze_module(rel, src))
+        findings.extend(analyze_slo_objectives(rel, src, registered))
     return findings

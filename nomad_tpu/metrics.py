@@ -13,6 +13,13 @@ time — how scattered object counters (matrix uploads, coalescer
 dispatches) unify into the registry without double bookkeeping.
 ``to_prometheus`` renders any snapshot in the Prometheus text
 exposition format for ``/v1/metrics?format=prometheus``.
+
+``RollingWindow`` is the sliding-window primitive the SLO engine
+(``nomad_tpu/obs/``) evaluates burn rates over: timestamped samples in a
+bounded deque, with count/rate/percentile readable over any trailing
+window.  ``Timer`` feeds one alongside its reservoir so windowed
+percentiles (``windowed(60)["p99_ms"]``) are available without a second
+observation on the hot path.
 """
 
 from __future__ import annotations
@@ -23,7 +30,77 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class RollingWindow:
+    """Timestamped samples in a bounded deque, aggregated over any
+    trailing window.  The write path is one deque append under a lock;
+    reads walk backwards from the newest sample and stop at the window
+    edge, so cost scales with the window's population, not the buffer.
+
+    Two uses: value samples (``observe`` latencies → ``percentile``)
+    and level samples of a monotonic counter (``observe`` the counter →
+    ``rate_of_change`` = Δvalue/Δt over the window, the Prometheus
+    ``rate()`` shape the SLO evaluator applies to throughput counters).
+    """
+
+    def __init__(self, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._samples: deque = deque(maxlen=maxlen)  # (ts, value)
+
+    def observe(self, value: float, ts: Optional[float] = None) -> None:
+        with self._lock:
+            self._samples.append((ts if ts is not None else time.time(), value))
+
+    def _window(
+        self, window_s: float, now: Optional[float] = None
+    ) -> List[Tuple[float, float]]:
+        cutoff = (now if now is not None else time.time()) - window_s
+        with self._lock:
+            out = []
+            for ts, v in reversed(self._samples):
+                if ts < cutoff:
+                    break
+                out.append((ts, v))
+        out.reverse()
+        return out
+
+    def count(self, window_s: float, now: Optional[float] = None) -> int:
+        return len(self._window(window_s, now))
+
+    def rate(self, window_s: float, now: Optional[float] = None) -> float:
+        """Samples per second over the trailing window."""
+        if window_s <= 0:
+            return 0.0
+        return len(self._window(window_s, now)) / window_s
+
+    def rate_of_change(
+        self, window_s: float, now: Optional[float] = None
+    ) -> float:
+        """Δvalue/Δt across the window — ``rate()`` over level samples
+        of a monotonic counter.  0.0 until two samples span the window."""
+        win = self._window(window_s, now)
+        if len(win) < 2:
+            return 0.0
+        (t0, v0), (t1, v1) = win[0], win[-1]
+        if t1 <= t0:
+            return 0.0
+        return (v1 - v0) / (t1 - t0)
+
+    def percentile(
+        self, window_s: float, q: float, now: Optional[float] = None
+    ) -> float:
+        vals = sorted(v for _, v in self._window(window_s, now))
+        if not vals:
+            return 0.0
+        rank = math.ceil(q * len(vals))
+        return vals[min(len(vals) - 1, max(0, rank - 1))]
+
+    def values(
+        self, window_s: float, now: Optional[float] = None
+    ) -> List[float]:
+        return [v for _, v in self._window(window_s, now)]
 
 
 class Timer:
@@ -34,8 +111,12 @@ class Timer:
         self.min = float("inf")
         self.max = 0.0
         self._samples: deque = deque(maxlen=reservoir)
+        # Timestamped twin of the reservoir: windowed percentiles for
+        # the SLO engine without a second observe on the hot path.
+        self.window = RollingWindow(maxlen=reservoir)
 
     def observe(self, seconds: float) -> None:
+        now = time.time()
         with self._lock:
             self.count += 1
             self.sum += seconds
@@ -44,6 +125,7 @@ class Timer:
             if seconds > self.max:
                 self.max = seconds
             self._samples.append(seconds)
+        self.window.observe(seconds, ts=now)
 
     @contextmanager
     def time(self):
@@ -79,6 +161,27 @@ class Timer:
             "p50_ms": round(self._percentile(samples, 0.50) * 1000.0, 3),
             "p95_ms": round(self._percentile(samples, 0.95) * 1000.0, 3),
             "p99_ms": round(self._percentile(samples, 0.99) * 1000.0, 3),
+        }
+
+    def windowed(self, window_s: float) -> Dict[str, float]:
+        """Percentiles over the trailing ``window_s`` seconds only —
+        the sliding-window view the SLO burn-rate math evaluates (the
+        plain reservoir never forgets a quiet period's samples)."""
+        vals = sorted(self.window.values(window_s))
+        n = len(vals)
+
+        def pct(q: float) -> float:
+            if not vals:
+                return 0.0
+            rank = math.ceil(q * n)
+            return vals[min(n - 1, max(0, rank - 1))]
+
+        return {
+            "count": n,
+            "mean_ms": round(sum(vals) / n * 1000.0, 3) if n else 0.0,
+            "p50_ms": round(pct(0.50) * 1000.0, 3),
+            "p95_ms": round(pct(0.95) * 1000.0, 3),
+            "p99_ms": round(pct(0.99) * 1000.0, 3),
         }
 
 
@@ -142,7 +245,9 @@ class MetricsRegistry:
 # Prometheus text exposition (https://prometheus.io/docs/instrumenting/exposition_formats/)
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
-_LABELED = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$")
+# DOTALL: label values may legally contain newlines — the exposition
+# layer escapes them, but the key regex must not refuse to parse them.
+_LABELED = re.compile(r"^(?P<name>[^{]+)\{(?P<labels>.*)\}$", re.DOTALL)
 
 
 def _prom_name(name: str) -> str:
@@ -165,28 +270,59 @@ def _split_key(key: str) -> "tuple[str, Dict[str, str]]":
     return m.group("name"), labels
 
 
+def _escape_label_value(value: str) -> str:
+    """Escape a label value per the exposition-format spec: backslash,
+    double-quote, and line-feed must be escaped inside the quotes
+    (backslash first, or the other escapes get double-escaped)."""
+    return (
+        value.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_series(base: str, labels: Dict[str, str]) -> str:
     name = _prom_name(base)
     if not labels:
         return name
     inner = ",".join(
-        '%s="%s"' % (_prom_name(k), labels[k]) for k in sorted(labels)
+        '%s="%s"' % (_prom_name(k), _escape_label_value(str(labels[k])))
+        for k in sorted(labels)
     )
     return "%s{%s}" % (name, inner)
+
+
+def _help_text(base: str, kind: str) -> str:
+    """One-line HELP: the registry's dotted metric name is the most
+    useful thing to echo — it is the key to grep for in the code."""
+    if kind == "summary":
+        return "latency summary of registry timer %s (milliseconds)" % base
+    return "registry metric %s" % base
 
 
 def to_prometheus(snapshot: Dict) -> str:
     """Render a flat snapshot (counters/gauges as numbers, timers as
     their summary dicts) in the Prometheus text exposition format.
     Timer summaries become ``<name>_ms{quantile=..}`` series plus
-    ``<name>_count`` / ``<name>_sum_ms``."""
+    ``<name>_count`` / ``<name>_sum_ms``.  Every metric family gets
+    ``# HELP`` and ``# TYPE`` header lines, emitted once per family
+    (labeled series of the same base share one header block)."""
     lines: List[str] = []
+    headered: set = set()
+
+    def _header(stem: str, base: str, kind: str) -> None:
+        if stem in headered:
+            return
+        headered.add(stem)
+        lines.append("# HELP %s %s" % (stem, _help_text(base, kind)))
+        lines.append("# TYPE %s %s" % (stem, kind))
+
     for key in sorted(snapshot):
         value = snapshot[key]
         base, labels = _split_key(key)
         if isinstance(value, dict):
             stem = _prom_name(base) + "_ms"
-            lines.append("# TYPE %s summary" % stem)
+            _header(stem, base, "summary")
             for q, field in (("0.5", "p50_ms"), ("0.95", "p95_ms"), ("0.99", "p99_ms")):
                 ql = dict(labels)
                 ql["quantile"] = q
@@ -203,8 +339,10 @@ def to_prometheus(snapshot: Dict) -> str:
                 )
             )
         elif isinstance(value, bool):
+            _header(_prom_name(base), base, "gauge")
             lines.append("%s %d" % (_prom_series(base, labels), int(value)))
         elif isinstance(value, (int, float)):
+            _header(_prom_name(base), base, "gauge")
             lines.append("%s %s" % (_prom_series(base, labels), value))
         # non-numeric snapshot entries (strings) are skipped
     return "\n".join(lines) + "\n"

@@ -1,0 +1,35 @@
+"""Cluster SLO observatory — burn rates, overload signals, `nomad top`.
+
+The measurement layer under ROADMAP item 3's admission control: the
+paper's north star (≥50K evals/s @ p99 < 5 ms) expressed as declarative
+:class:`~.slo.SLOSpec` objectives, evaluated continuously by the
+leader's :class:`~.evaluator.SLOObservatory`, fanned out as ``SLO`` /
+``Health`` events on the store's EventBroker, and surfaced at
+``GET /v1/slo`` / ``GET /v1/health`` and in the ``nomad top``
+dashboard (:mod:`.top`).  See OBSERVABILITY.md.
+"""
+
+from .evaluator import SLOObservatory, TOPIC_HEALTH, TOPIC_SLO
+from .health import compute_health, collect_signals
+from .slo import (
+    SLOEngine,
+    SLOSpec,
+    STATUS_BREACHED,
+    STATUS_OK,
+    STATUS_PENDING,
+    default_slos,
+)
+
+__all__ = [
+    "SLOEngine",
+    "SLOObservatory",
+    "SLOSpec",
+    "STATUS_BREACHED",
+    "STATUS_OK",
+    "STATUS_PENDING",
+    "TOPIC_HEALTH",
+    "TOPIC_SLO",
+    "collect_signals",
+    "compute_health",
+    "default_slos",
+]

@@ -94,6 +94,12 @@ class ServerConfig:
     scheduler_config: SchedulerConfiguration = field(
         default_factory=SchedulerConfiguration
     )
+    # SLO observatory (nomad_tpu/obs/): the leader's burn-rate loop.
+    # slo_specs None = the BASELINE-derived defaults (obs.default_slos);
+    # [] disables SLO evaluation while keeping /v1/health live.
+    slo_enabled: bool = True
+    slo_interval: float = 1.0
+    slo_specs: Optional[List] = None
 
 
 class Server:
@@ -158,6 +164,16 @@ class Server:
 
         trace.set_default_metrics(self.metrics)
         self._register_telemetry_gauges()
+
+        # SLO observatory: constructed always (the /v1/slo + /v1/health
+        # surface must answer on followers too), ticking only on leaders.
+        from ..obs import SLOObservatory
+
+        self.observatory = SLOObservatory(
+            self,
+            specs=self.config.slo_specs,
+            interval=self.config.slo_interval,
+        )
 
         self._index_lock = threading.Lock()
         self._index = 0
@@ -327,6 +343,8 @@ class Server:
         self.deployment_watcher.start()
         self.drainer.start()
         self.periodic.start()  # restores periodic jobs from state
+        if self.config.slo_enabled:
+            self.observatory.start()
         self._shutdown.clear()
         if self._reaper is None or not self._reaper.is_alive():
             self._reaper = threading.Thread(
@@ -345,6 +363,7 @@ class Server:
         self.deployment_watcher.stop()
         self.drainer.stop()
         self.periodic.stop()
+        self.observatory.stop()
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -354,6 +373,7 @@ class Server:
         self.deployment_watcher.stop()
         self.drainer.stop()
         self.periodic.stop()
+        self.observatory.stop()
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()
@@ -694,6 +714,9 @@ class Server:
 
     def _on_heartbeat_expired(self, node_id: str) -> None:
         log.info("node %s missed heartbeat, marking down", node_id)
+        # Health signal: the heartbeat_liveness SLO and the overload
+        # score both rate this counter (obs/evaluator.py).
+        self.metrics.incr("nomad.heartbeat.missed")
         self.update_node_status(node_id, NodeStatus.DOWN.value)
 
     def _capacity_added(self, node: Node, index: int) -> None:

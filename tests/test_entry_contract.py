@@ -80,7 +80,7 @@ def test_entry_compiles_fresh_process():
     assert "entry-contract-ok" in p.stdout
 
 
-def test_bench_smoke_small():
+def test_bench_smoke_small(tmp_path):
     """bench.py end-to-end on a toy cluster: must print exactly one JSON
     line with the required keys, on whatever platform is available."""
     import json
@@ -88,6 +88,9 @@ def test_bench_smoke_small():
     env = _driver_like_env()
     env.update(
         JAX_PLATFORMS="cpu",
+        # Toy-cluster numbers must not land in the committed regression
+        # ledger — they'd poison the real baselines.
+        NOMAD_TPU_BENCH_LEDGER=str(tmp_path / "ledger.jsonl"),
         BENCH_NODES="64",
         BENCH_ALLOCS="2000",
         BENCH_BATCH="8",

@@ -511,6 +511,77 @@ class TestObsPass:
         assert obspass.run(repo_root()) == []
 
 
+class TestO002SloObjectives:
+    def test_unregistered_objective_fires(self):
+        reg = obspass.collect_metric_names(
+            'm = metrics.timer("nomad.eval.latency")')
+        fs = obspass.analyze_slo_objectives("nomad_tpu/m.py", _dedent('''
+            from .obs import SLOSpec
+
+            SPECS = [SLOSpec(name="lat", objective="nomad.evals.latency",
+                             op="<", target=5.0)]
+        '''), reg)
+        assert len(fs) == 1 and fs[0].rule == "O002", fs
+        assert fs[0].symbol == "lat"
+        assert "nomad.evals.latency" in fs[0].message
+
+    def test_registered_objective_is_clean(self):
+        reg = obspass.collect_metric_names(
+            'metrics.timer("nomad.eval.latency")')
+        fs = obspass.analyze_slo_objectives("nomad_tpu/m.py", _dedent('''
+            SPECS = [SLOSpec(name="lat", objective="nomad.eval.latency",
+                             op="<", target=5.0)]
+        '''), reg)
+        assert fs == [], fs
+
+    def test_name_universe_covers_all_registration_shapes(self):
+        reg = obspass.collect_metric_names(_dedent('''
+            def setup(metrics, trace, snap):
+                metrics.timer("nomad.a.timer")
+                metrics.incr("nomad.b.counter")
+                metrics.gauge_fn("nomad.c.gauge", lambda: 0)
+                with trace.span("plan.apply"):
+                    pass
+                snap["nomad.d.handrolled"] = 1
+        '''))
+        assert reg == {
+            "nomad.a.timer", "nomad.b.counter", "nomad.c.gauge",
+            "nomad.phase.plan.apply", "nomad.d.handrolled",
+        }
+
+    def test_positional_objective_checked(self):
+        fs = obspass.analyze_slo_objectives(
+            "nomad_tpu/m.py",
+            'S = SLOSpec("lat", "nomad.bogus", "<", 5.0)',
+            {"nomad.real"},
+        )
+        assert len(fs) == 1 and fs[0].symbol == "lat", fs
+
+    def test_dynamic_objective_out_of_scope(self):
+        # Only literals are checked — a computed name can't be resolved
+        # statically and must not flag.
+        fs = obspass.analyze_slo_objectives("nomad_tpu/m.py", _dedent('''
+            def make(name):
+                return SLOSpec(name="x", objective=name, op="<", target=1.0)
+        '''), set())
+        assert fs == [], fs
+
+    def test_default_slos_resolve_in_production_tree(self):
+        # The shipped specs must stay wired to real metrics: collect the
+        # whole package's name universe, check obs/slo.py against it.
+        from nomad_tpu.lint import repo_root
+
+        root = repo_root()
+        registered = set()
+        for rel, src in obspass._walk_sources(root):
+            registered |= obspass.collect_metric_names(src)
+        import os as _os
+        with open(_os.path.join(root, "nomad_tpu", "obs", "slo.py")) as fh:
+            src = fh.read()
+        assert obspass.analyze_slo_objectives(
+            "nomad_tpu/obs/slo.py", src, registered) == []
+
+
 # ----------------------------------------------------------------------
 # Baseline machinery
 # ----------------------------------------------------------------------

@@ -1,10 +1,16 @@
 """Metrics registry units: percentile definition, empty-timer edge,
-labeled counters, pull gauges, and the Prometheus text exposition."""
+labeled counters, pull gauges, rolling windows, and the Prometheus
+text exposition."""
 
 from __future__ import annotations
 
+import time
+
+import pytest
+
 from nomad_tpu.metrics import (
     MetricsRegistry,
+    RollingWindow,
     Timer,
     labeled,
     to_prometheus,
@@ -115,3 +121,90 @@ class TestPrometheusExposition:
         text = to_prometheus({"version": "1.2.3", "n": 1})
         assert "version" not in text
         assert "n 1" in text
+
+
+class TestPrometheusHeaders:
+    def test_help_and_type_emitted_once_per_family(self):
+        reg = MetricsRegistry()
+        reg.incr("nomad.kernel.launches", path="batched")
+        reg.incr("nomad.kernel.launches", path="solo")
+        text = to_prometheus(reg.snapshot())
+        # Two labeled series, ONE header block, header before the series.
+        assert text.count("# HELP nomad_kernel_launches ") == 1
+        assert text.count("# TYPE nomad_kernel_launches gauge") == 1
+        assert text.index("# HELP nomad_kernel_launches") < text.index(
+            'nomad_kernel_launches{path="batched"}'
+        )
+
+    def test_timer_summary_headers(self):
+        reg = MetricsRegistry()
+        reg.timer("nomad.plan.apply").observe(0.001)
+        text = to_prometheus(reg.snapshot())
+        assert "# HELP nomad_plan_apply_ms " in text
+        assert "# TYPE nomad_plan_apply_ms summary" in text
+        # The HELP line echoes the dotted registry name — the greppable key.
+        help_line = [
+            line for line in text.splitlines()
+            if line.startswith("# HELP nomad_plan_apply_ms")
+        ][0]
+        assert "nomad.plan.apply" in help_line
+
+
+class TestLabelValueEscaping:
+    # to_prometheus accepts any snapshot dict, so hostile label values
+    # can be exercised directly on the flat-key form.
+
+    def test_backslash_quote_newline_escaped(self):
+        text = to_prometheus({'m{k=a\\b"c\nd}': 1})
+        assert 'm{k="a\\\\b\\"c\\nd"} 1' in text
+
+    def test_backslash_escaped_before_quote(self):
+        # A literal \" in the value must become \\\" (escape the
+        # backslash first), not \\" which would terminate the string.
+        text = to_prometheus({'m{k=x\\"y}': 2})
+        assert 'm{k="x\\\\\\"y"} 2' in text
+
+    def test_plain_values_untouched(self):
+        text = to_prometheus({"m{path=batched}": 3})
+        assert 'm{path="batched"} 3' in text
+
+
+class TestRollingWindow:
+    def test_window_count_excludes_old_samples(self):
+        w = RollingWindow()
+        now = 1000.0
+        for i in range(10):  # ts 991..1000
+            w.observe(float(i), ts=991.0 + i)
+        assert w.count(5.0, now=now) == 6     # ts >= 995
+        assert w.count(100.0, now=now) == 10
+        assert w.rate(5.0, now=now) == pytest.approx(6 / 5.0)
+
+    def test_rate_of_change_is_counter_delta(self):
+        w = RollingWindow()
+        w.observe(0.0, ts=100.0)
+        w.observe(1000.0, ts=110.0)
+        assert w.rate_of_change(60.0, now=110.0) == pytest.approx(100.0)
+        # Fewer than two samples in window -> 0, never a spike.
+        assert w.rate_of_change(5.0, now=130.0) == 0.0
+
+    def test_percentile_ceil_rank_over_window(self):
+        w = RollingWindow()
+        for i in range(1, 101):
+            w.observe(float(i), ts=1000.0)
+        assert w.percentile(60.0, 0.99, now=1000.0) == 99.0
+        assert w.percentile(60.0, 0.50, now=1000.0) == 50.0
+        assert w.percentile(0.0, 0.99, now=2000.0) == 0.0  # empty window
+
+    def test_timer_windowed_forgets_quiet_period(self):
+        t = Timer()
+        # A slow sample far outside the window (the reservoir keeps it).
+        t.window.observe(5.0, ts=time.time() - 3600)
+        t._samples.append(5.0)
+        t.count += 1
+        for _ in range(20):
+            t.observe(0.001)
+        win = t.windowed(60.0)
+        assert win["count"] == 20
+        assert win["p99_ms"] == pytest.approx(1.0)
+        # Lifetime reservoir still sees the old outlier.
+        assert t.snapshot()["p99_ms"] >= 1.0

@@ -1,0 +1,378 @@
+"""SLO observatory: burn-rate engine units, composite health score,
+the /v1/slo + /v1/health HTTP surface, the end-to-end chaos-breach
+path (wedged pipeline → SLO event → degraded health → flight record
+naming the breached SLO), and the <1% evaluator overhead gate."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock, trace
+from nomad_tpu.api import APIClient
+from nomad_tpu.api.agent import Agent, AgentConfig
+from nomad_tpu.chaos import FaultSpec, injected
+from nomad_tpu.metrics import MetricsRegistry
+from nomad_tpu.obs import (
+    SLOEngine,
+    SLOSpec,
+    STATUS_BREACHED,
+    STATUS_OK,
+    STATUS_PENDING,
+    compute_health,
+    default_slos,
+)
+from nomad_tpu.obs import evaluator as evaluator_mod
+from nomad_tpu.server import Server, ServerConfig
+
+
+# ----------------------------------------------------------------------
+# Burn-rate engine units
+# ----------------------------------------------------------------------
+
+
+def _spec(**kw):
+    base = dict(name="lat", objective="m", op="<", target=5.0,
+                kind="gauge", windows=(1.0, 3.0), min_samples=3)
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+class TestEngine:
+    def test_good_samples_reach_ok(self):
+        eng = SLOEngine([_spec()])
+        for i in range(14):
+            eng.tick({"m": 1.0}, now=100.0 + i * 0.25)
+        assert eng.state("lat").status == STATUS_OK
+
+    def test_sustained_breach_and_burn_units(self):
+        # budget 0.05 + all-bad samples -> burn = 1.0 / 0.05 = 20 on
+        # both windows, far over fast_burn=2 / slow_burn=1.
+        eng = SLOEngine([_spec()])
+        transitions = []
+        for i in range(14):
+            transitions += eng.tick({"m": 9.0}, now=100.0 + i * 0.25)
+        st = eng.state("lat")
+        assert st.status == STATUS_BREACHED
+        assert st.breached_since is not None
+        fast, n_fast = eng._burn(st, 1.0, 100.0 + 13 * 0.25)
+        assert n_fast >= 3
+        assert fast == pytest.approx(20.0)
+        assert [(s.name, new) for s, _, new in transitions] == [
+            ("lat", STATUS_BREACHED)
+        ]
+
+    def test_single_bad_tick_does_not_breach(self):
+        # Multi-window rule: one bad sample in an otherwise-good stream
+        # burns the fast window briefly but never the slow one.
+        eng = SLOEngine([_spec(budget=0.30)])
+        now = 100.0
+        for i in range(20):
+            v = 9.0 if i == 10 else 1.0
+            eng.tick({"m": v}, now=now + i * 0.25)
+        assert eng.state("lat").status == STATUS_OK
+
+    def test_min_samples_keeps_pending(self):
+        eng = SLOEngine([_spec(min_samples=50)])
+        for i in range(10):
+            eng.tick({"m": 9.0}, now=100.0 + i * 0.01)
+        assert eng.state("lat").status == STATUS_PENDING
+
+    def test_recovery_transition(self):
+        eng = SLOEngine([_spec()])
+        now, i = 100.0, 0
+        for _ in range(14):
+            eng.tick({"m": 9.0}, now=now + i * 0.25)
+            i += 1
+        assert eng.state("lat").status == STATUS_BREACHED
+        trans = []
+        for _ in range(10):
+            trans += eng.tick({"m": 1.0}, now=now + i * 0.25)
+            i += 1
+        assert eng.state("lat").status == STATUS_OK
+        assert (STATUS_BREACHED, STATUS_OK) in [
+            (old, new) for _, old, new in trans
+        ]
+
+    def test_rate_kind_samples_counter_delta(self):
+        spec = _spec(name="thr", objective="c", op=">=", target=50.0,
+                     kind="rate", windows=(10.0, 30.0), min_samples=1)
+        eng = SLOEngine([spec])
+        eng.tick({"c": 0}, now=100.0)
+        eng.tick({"c": 1000}, now=110.0)
+        assert eng.state("thr").last_value == pytest.approx(100.0)
+
+    def test_timer_kind_uses_windowed_percentile(self):
+        # An ancient slow sample lives in the lifetime reservoir but
+        # must not poison the SLO: the engine reads the rolling window.
+        reg = MetricsRegistry()
+        t = reg.timer("nomad.eval.latency")
+        t.window.observe(1.0, ts=time.time() - 3600)  # 1000 ms, stale
+        for _ in range(20):
+            t.observe(0.001)
+        spec = _spec(name="p99", objective="nomad.eval.latency",
+                     kind="timer", windows=(60.0, 300.0), min_samples=1)
+        eng = SLOEngine([spec])
+        eng.tick({}, registry=reg)
+        assert eng.state("p99").last_value == pytest.approx(1.0)  # ms
+
+    def test_unregistered_objective_never_samples(self):
+        eng = SLOEngine([_spec(objective="nomad.not.registered")])
+        for i in range(20):
+            eng.tick({"m": 9.0}, now=100.0 + i * 0.25)
+        st = eng.state("lat")
+        assert st.status == STATUS_PENDING
+        assert st.samples.count(1e9, now=200.0) == 0
+
+    def test_report_shape(self):
+        eng = SLOEngine(default_slos())
+        rows = eng.report(now=100.0)
+        assert {r["name"] for r in rows} == {
+            "placement_latency_p99_ms", "eval_throughput",
+            "heartbeat_liveness",
+        }
+        for r in rows:
+            for key in ("objective", "op", "target", "value", "status",
+                        "burn_rate_fast", "burn_rate_slow", "windows_s",
+                        "budget", "samples"):
+                assert key in r, r
+
+
+# ----------------------------------------------------------------------
+# Composite health units
+# ----------------------------------------------------------------------
+
+
+class TestHealth:
+    def test_unloaded_cluster_scores_100(self):
+        h = compute_health({})
+        assert h["status"] == "ok"
+        assert h["score"] == 100.0
+        assert h["pressure"] == 0.0
+
+    def test_breached_slo_forces_degraded(self):
+        h = compute_health({}, breached_slos=["placement_latency_p99_ms"])
+        assert h["status"] == "degraded"
+        assert h["breached_slos"] == ["placement_latency_p99_ms"]
+
+    def test_soft_knee_is_half_pressure_at_knee(self):
+        # broker_backlog knee is 256: exactly 0.5 input pressure there.
+        h = compute_health({"broker_backlog": 256})
+        assert h["inputs"]["broker_backlog"] == pytest.approx(0.5)
+        assert h["status"] == "ok"  # one input at its knee is not degraded
+
+    def test_saturation_goes_critical(self):
+        sig = {
+            "broker_backlog": 1e9, "blocked_evals": 1e9,
+            "plan_queue_depth": 1e9, "plan_queue_wait_p99_ms": 1e9,
+            "heartbeat_miss_rate": 1e9,
+            "pipeline_inflight": 8, "pipeline_depth": 8,
+        }
+        h = compute_health(sig)
+        assert h["status"] == "critical"
+        assert h["score"] < 15.0
+
+    def test_pipeline_occupancy_is_a_ratio(self):
+        h = compute_health({"pipeline_inflight": 4, "pipeline_depth": 8})
+        assert h["inputs"]["pipeline_occupancy"] == pytest.approx(0.5)
+
+
+# ----------------------------------------------------------------------
+# HTTP surface
+# ----------------------------------------------------------------------
+
+
+def _server_config(**kw):
+    base = dict(num_workers=1, node_capacity=16,
+                heartbeat_min_ttl=600, heartbeat_max_ttl=900)
+    base.update(kw)
+    return ServerConfig(**base)
+
+
+class TestHTTPSurface:
+    def test_slo_and_health_endpoints(self):
+        agent = Agent(AgentConfig(
+            client_enabled=False,
+            server_config=_server_config(slo_interval=0.05),
+        ))
+        agent.start()
+        try:
+            client = APIClient(agent.rpc_addr)
+            rep = client.slo()
+            assert {s["name"] for s in rep["slos"]} == {
+                "placement_latency_p99_ms", "eval_throughput",
+                "heartbeat_liveness",
+            }
+            # A just-started quiet server must not read as breached.
+            assert all(s["status"] != "breached" for s in rep["slos"])
+            h = client.health()
+            assert h["status"] == "ok"
+            assert 0.0 <= h["pressure"] <= 1.0
+            assert "broker_backlog" in h["inputs"]
+            # Observatory gauges ride the ordinary metrics surface.
+            snap = client.metrics()
+            assert "nomad.health.score" in snap
+            assert "nomad.slo.breached{slo=placement_latency_p99_ms}" in snap
+        finally:
+            agent.shutdown()
+
+
+# ----------------------------------------------------------------------
+# End-to-end: chaos wedges the pipeline, the SLO path lights up
+# ----------------------------------------------------------------------
+
+
+class TestChaosBreachEndToEnd:
+    def test_wedged_pipeline_breaches_slo(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_TRACE_DIR", str(tmp_path))
+        # Breach dumps have their own per-process budget (separate from
+        # trace.auto_dump's) — reset it so earlier tests' breaches can't
+        # starve this one.
+        monkeypatch.setattr(evaluator_mod, "_breach_dumps_used", 0)
+        trace.configure(enabled=True, sample=1.0)
+
+        # Tight spec: the default 60/300s windows and min_samples=10
+        # would need minutes of soak — the semantics under test are the
+        # transitions, not the production cadence.
+        spec = SLOSpec(
+            name="placement_latency_p99_ms",
+            objective="nomad.eval.latency",
+            kind="timer", timer_field="p99_ms",
+            op="<", target=5.0,
+            windows=(0.4, 1.2), min_samples=3,
+        )
+        agent = Agent(AgentConfig(
+            client_enabled=False,
+            server_config=_server_config(
+                slo_interval=0.05, slo_specs=[spec],
+            ),
+        ))
+        seed = 1337
+        # Every dispatch eats a 20ms injected delay: each eval's
+        # end-to-end latency lands far over the 5ms target.
+        schedule = [FaultSpec("coalescer.dispatch", "delay",
+                              p=1.0, duration=0.02)]
+        slo_events = []
+        got_breach = threading.Event()
+        with injected(seed=seed, schedule=schedule):
+            agent.start()
+            try:
+                url = (f"{agent.rpc_addr}/v1/event/stream"
+                       f"?topic=SLO:*&topic=Health:*")
+
+                def consume():
+                    with urllib.request.urlopen(url, timeout=60) as resp:
+                        for raw in resp:
+                            obj = json.loads(raw)
+                            if not obj:
+                                continue
+                            slo_events.append(obj)
+                            if obj.get("Type") == "SLOBreached":
+                                got_breach.set()
+                                return
+
+                t = threading.Thread(target=consume, daemon=True)
+                t.start()
+                time.sleep(0.2)  # let the subscription attach
+
+                srv = agent.server
+                srv.register_node(mock.node())
+                deadline = time.time() + 60
+                while not got_breach.is_set() and time.time() < deadline:
+                    job = mock.job()
+                    job.task_groups[0].count = 1
+                    ev = srv.submit_job(job)
+                    srv.wait_for_eval(ev.id, timeout=30)
+
+                assert got_breach.wait(timeout=10), (
+                    "no SLOBreached event on /v1/event/stream; "
+                    f"report={srv.observatory.slo_report()}"
+                )
+                breach = [e for e in slo_events
+                          if e.get("Type") == "SLOBreached"][0]
+                assert breach["Topic"] == "SLO"
+                assert breach["Key"] == "placement_latency_p99_ms"
+                assert breach["Payload"]["value"] > 5.0
+                assert breach["Payload"]["to"] == "breached"
+
+                # Health must reflect the burned budget even though the
+                # queues themselves are calm.
+                client = APIClient(agent.rpc_addr)
+                h = client.health()
+                assert h["status"] in ("degraded", "critical"), h
+                assert "placement_latency_p99_ms" in h["breached_slos"]
+                rep = client.slo()
+                row = [s for s in rep["slos"]
+                       if s["name"] == "placement_latency_p99_ms"][0]
+                assert row["status"] == "breached"
+                assert row["burn_rate_fast"] > 2.0
+
+                # The breach auto-dumped a flight record carrying the
+                # breached SLO and the chaos seed — the replayable
+                # postmortem path chaos invariant violations use.
+                dumps = srv.observatory.breach_dumps
+                assert dumps, "no flight record dumped on breach"
+                with open(dumps[0]) as fh:
+                    doc = json.load(fh)
+                meta = doc["metadata"]
+                assert meta["breached_slo"] == "placement_latency_p99_ms"
+                assert meta["reason"].startswith("slo-breach-")
+                assert meta["chaos_seed"] == seed
+                assert meta["burn_rate_fast"] > 2.0
+                assert os.path.dirname(dumps[0]) == str(tmp_path)
+            finally:
+                agent.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Overhead gate: the observatory must cost <1% of the host loop
+# ----------------------------------------------------------------------
+
+# One tick per interval (default 1s); 1% of that is 10ms. Assert with
+# the same 5x margin discipline as tests/test_trace_overhead.py so a
+# loaded CI box doesn't flake while a genuinely heavy tick (an O(ring)
+# scan, a full-registry snapshot) still trips.
+TICK_INTERVAL_S = 1.0
+MAX_OVERHEAD_FRAC = 0.01
+CEILING_S = TICK_INTERVAL_S * MAX_OVERHEAD_FRAC / 5.0
+
+
+def _best_of(rounds, n, fn):
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        fn(n)
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best
+
+
+class TestObservatoryOverhead:
+    def test_tick_cost_under_budget(self):
+        srv = Server(_server_config(slo_enabled=False))
+        try:
+            # Populate the objective timer so the windowed-percentile
+            # walk (the tick's dominant term) runs on real data.
+            t = srv.metrics.timer("nomad.eval.latency")
+            for i in range(1024):
+                t.observe(0.001 + (i % 7) * 0.0001)
+            obs = srv.observatory
+
+            def burn(n):
+                for _ in range(n):
+                    obs.tick()
+
+            burn(20)  # warm: gauge registration paths, window alloc
+            per_tick = _best_of(5, 100, burn)
+            assert per_tick < CEILING_S, (
+                f"observatory tick costs {per_tick * 1e3:.2f}ms — over "
+                f"the {CEILING_S * 1e3:.1f}ms gate "
+                f"({MAX_OVERHEAD_FRAC:.0%} of the {TICK_INTERVAL_S:.0f}s "
+                f"interval / 5 margin)"
+            )
+        finally:
+            srv.shutdown()

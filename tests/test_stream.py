@@ -73,6 +73,86 @@ class TestBroker:
 
 
 # ----------------------------------------------------------------------
+# Slow subscribers vs the bounded ring
+# ----------------------------------------------------------------------
+
+
+class TestSlowSubscriber:
+    def test_gap_event_when_resuming_past_eviction(self):
+        # A consumer that fell 12 events behind an 8-slot ring must get
+        # the synthetic gap marker first, not a silently-holed history.
+        b = EventBroker(buffer_size=8)
+        b.publish([
+            Event(topic="Job", type="T", key=f"k{i}", index=i)
+            for i in range(1, 21)
+        ])
+        sub = b.subscribe({"Job": ["*"]}, from_index=2)
+        evs = []
+        while True:
+            batch = sub.next(timeout=0.3)
+            if not batch:
+                break
+            evs.extend(batch)
+        assert evs, "expected gap marker + replay"
+        gap = evs[0]
+        assert (gap.topic, gap.type) == ("Framework", "EventStreamGap")
+        assert gap.payload["requested_index"] == 2
+        assert gap.payload["dropped_through"] == 12  # 20 - 8 evicted
+        replay = [e.index for e in evs[1:]]
+        assert replay == list(range(13, 21))  # what the ring still holds
+
+    def test_clean_resume_within_buffer(self):
+        # from_index still covered by the ring: exact suffix, no gap.
+        b = EventBroker(buffer_size=64)
+        b.publish([
+            Event(topic="Job", type="T", key=f"k{i}", index=i)
+            for i in range(1, 11)
+        ])
+        sub = b.subscribe({"Job": ["*"]}, from_index=4)
+        evs = sub.next(timeout=2)
+        assert all(e.type != "EventStreamGap" for e in evs)
+        assert [e.index for e in evs] == [5, 6, 7, 8, 9, 10]
+
+    def test_concurrent_publish_during_eviction(self):
+        # Subscribing at a stale cursor WHILE the ring is evicting must
+        # never produce out-of-order replays or a missing gap marker.
+        b = EventBroker(buffer_size=16)
+        done = threading.Event()
+
+        def writer():
+            for i in range(1, 1001):
+                b.publish([
+                    Event(topic="Job", type="T", key=f"k{i}", index=i)
+                ])
+            done.set()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        rounds = 0
+        while not done.is_set() and rounds < 50:
+            sub = b.subscribe({"Job": ["*"]}, from_index=1)
+            evs = sub.next(timeout=0.2)
+            sub.close()
+            rounds += 1
+            if not evs:
+                continue
+            job_idxs = [e.index for e in evs if e.topic == "Job"]
+            assert job_idxs == sorted(job_idxs), job_idxs
+            if evs[0].type == "EventStreamGap":
+                # Replays must start strictly after the declared gap.
+                dropped = evs[0].payload["dropped_through"]
+                assert all(i > dropped for i in job_idxs)
+        t.join(timeout=30)
+        # By the end eviction has long passed index 1: a stale resume
+        # must see the gap with eviction fully accounted.
+        sub = b.subscribe({"Job": ["*"]}, from_index=1)
+        evs = sub.next(timeout=2)
+        sub.close()
+        assert evs[0].type == "EventStreamGap"
+        assert evs[0].payload["dropped_through"] == 1000 - 16
+
+
+# ----------------------------------------------------------------------
 # Store publishes over a full lifecycle
 # ----------------------------------------------------------------------
 

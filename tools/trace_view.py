@@ -6,10 +6,15 @@ Usage:
     nomad-tpu trace dump -o trace.json      # or any flight-*.json dump
     python tools/trace_view.py trace.json
     python tools/trace_view.py trace.json --trace eval-abc123
+    python tools/trace_view.py trace.json --phase plan.apply --slowest 10
 
 Per-phase table: span count, total/mean/max duration, share of the
 summed root-span time.  With ``--trace ID`` prints that eval's span
-tree with per-span durations instead.
+tree with per-span durations instead.  ``--phase NAME`` narrows any
+view to spans whose phase name contains NAME (so ``--phase plan``
+matches plan.queue_wait + plan.apply); ``--slowest N`` lists the N
+longest individual spans — the first question a flight record gets
+("which eval blew the p99?") answered without Perfetto.
 
 For the full timeline, load the same file in https://ui.perfetto.dev
 (drag the file into the page) — spans are grouped per thread with
@@ -67,6 +72,30 @@ def summarize(events: List[Dict[str, Any]]) -> None:
     print("full timeline: load this file in https://ui.perfetto.dev")
 
 
+def filter_phase(
+    events: List[Dict[str, Any]], phase: str
+) -> List[Dict[str, Any]]:
+    """Spans whose name contains ``phase`` (substring, so a family
+    prefix like ``plan`` selects the whole plan.* group)."""
+    return [e for e in events if phase in e.get("name", "")]
+
+
+def show_slowest(events: List[Dict[str, Any]], n: int) -> None:
+    """The N longest individual spans, slowest first."""
+    ranked = sorted(events, key=lambda e: -e.get("dur", 0))[:n]
+    if not ranked:
+        print("no complete spans in file")
+        return
+    hdr = f"{'phase':<28}{'dur ms':>10}  {'trace':<38}{'ts us':>16}"
+    print(hdr)
+    print("-" * len(hdr))
+    for e in ranked:
+        args = e.get("args", {})
+        print(f"{e['name']:<28}{e.get('dur', 0) / 1000.0:>10.3f}  "
+              f"{str(args.get('trace', '-')):<38}{e.get('ts', 0):>16}")
+    print(f"\ntop {len(ranked)} of {len(events)} spans by duration")
+
+
 def show_trace(events: List[Dict[str, Any]], trace_id: str) -> None:
     mine = [e for e in events
             if e.get("args", {}).get("trace") == trace_id]
@@ -98,10 +127,22 @@ def main(argv: List[str] | None = None) -> int:
                                  "flight-*.json)")
     ap.add_argument("--trace", default="",
                     help="print one trace's span tree instead")
+    ap.add_argument("--phase", default="",
+                    help="only spans whose phase name contains this")
+    ap.add_argument("--slowest", type=int, default=0, metavar="N",
+                    help="list the N longest spans instead of the table")
     args = ap.parse_args(argv)
     events = load_events(args.path)
+    if args.phase:
+        events = filter_phase(events, args.phase)
+        if not events:
+            print(f"no spans matching phase {args.phase!r}",
+                  file=sys.stderr)
+            return 1
     if args.trace:
         show_trace(events, args.trace)
+    elif args.slowest > 0:
+        show_slowest(events, args.slowest)
     else:
         summarize(events)
     return 0
