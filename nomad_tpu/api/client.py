@@ -1,28 +1,80 @@
 """HTTP API client — what the CLI and external users consume.
 
 Reference: the ``api/`` Go client package (api/jobs.go etc.).
+
+The client is a well-behaved citizen under overload: a ``429 Too Many
+Requests`` from the server's admission gate is retried through the
+shared :mod:`..retry` backoff, honoring the ``Retry-After`` hint the
+gate computed from the token bucket's actual deficit.  Waiting is
+``max(backoff, Retry-After)`` — decorrelated jitter on top of the
+server's floor, so a flash crowd of clients does not re-synchronize
+into a retry storm.  ``NOMAD_TPU_RETRY_429_ATTEMPTS=1`` disables
+retrying (callers see the 429 immediately).
 """
 
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from ..retry import Backoff, RetryPolicy, env_int
+
 
 class APIError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(
+        self, code: int, message: str,
+        retry_after: Optional[float] = None,
+    ):
         super().__init__(f"HTTP {code}: {message}")
         self.code = code
+        self.retry_after = retry_after
+
+
+def _rate_limit_policy() -> RetryPolicy:
+    return RetryPolicy(
+        base_delay=0.2,
+        max_delay=10.0,
+        max_attempts=env_int("NOMAD_TPU_RETRY_429_ATTEMPTS", 3),
+    )
 
 
 class APIClient:
-    def __init__(self, address: str = "http://127.0.0.1:4646", token: str = ""):
+    def __init__(
+        self, address: str = "http://127.0.0.1:4646", token: str = "",
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
         self.address = address.rstrip("/")
         self.token = token  # X-Nomad-Token (SecretID) on every request
+        self.retry_policy = retry_policy or _rate_limit_policy()
+        self.rate_limited = 0  # 429s seen (retried or not)
 
     def _call(
+        self, method: str, path: str, body: Optional[Any] = None
+    ) -> Any:
+        backoff = Backoff(self.retry_policy)
+        attempts = 0
+        while True:
+            try:
+                return self._call_once(method, path, body)
+            except APIError as exc:
+                if exc.code != 429:
+                    raise
+                self.rate_limited += 1
+                attempts += 1
+                cap = self.retry_policy.max_attempts or 1
+                if attempts >= cap:
+                    raise
+                # Server's floor wins over our jittered backoff — never
+                # retry before the gate says the bucket refills.
+                delay = backoff.next_delay()
+                if exc.retry_after is not None:
+                    delay = max(delay, exc.retry_after)
+                time.sleep(delay)
+
+    def _call_once(
         self, method: str, path: str, body: Optional[Any] = None
     ) -> Any:
         data = json.dumps(body).encode() if body is not None else None
@@ -41,7 +93,14 @@ class APIClient:
                 msg = json.loads(exc.read()).get("error", str(exc))
             except Exception:  # noqa: BLE001
                 msg = str(exc)
-            raise APIError(exc.code, msg) from exc
+            retry_after = None
+            ra = exc.headers.get("Retry-After") if exc.headers else None
+            if ra is not None:
+                try:
+                    retry_after = float(ra)
+                except ValueError:
+                    pass
+            raise APIError(exc.code, msg, retry_after=retry_after) from exc
 
     # Jobs ------------------------------------------------------------
 
@@ -390,6 +449,11 @@ class APIClient:
     def health(self) -> Dict:
         """Composite health: status band, score, pressure inputs."""
         return self._call("GET", "/v1/health")
+
+    def overload(self) -> Dict:
+        """Overload controller report: state machine, pressure windows,
+        flip budget, per-actuator stats (obs/controller.py)."""
+        return self._call("GET", "/v1/overload")
 
     # Tracing -----------------------------------------------------------
 

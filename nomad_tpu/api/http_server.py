@@ -38,10 +38,14 @@ def _dump(obj: Any, exclude: Tuple[str, ...] = ()) -> Any:
 
 
 class HTTPError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(
+        self, code: int, message: str,
+        headers: Optional[Dict[str, str]] = None,
+    ):
         super().__init__(message)
         self.code = code
         self.message = message
+        self.headers = headers or {}
 
 
 @dataclasses.dataclass
@@ -66,11 +70,16 @@ class HTTPAPIServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _respond(self, code: int, payload: Any) -> None:
+            def _respond(
+                self, code: int, payload: Any,
+                headers: Optional[Dict[str, str]] = None,
+            ) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -150,14 +159,27 @@ class HTTPAPIServer:
                     else:
                         self._respond(200, result)
                 except HTTPError as exc:
-                    self._respond(exc.code, {"error": exc.message})
+                    self._respond(
+                        exc.code, {"error": exc.message},
+                        headers=exc.headers,
+                    )
                 except Exception as exc:  # noqa: BLE001
+                    from ..server.admission import RateLimitError
                     from ..server.replication import NotLeaderError
 
                     if isinstance(exc, NotLeaderError):
                         self._respond(409, {
                             "error": f"not leader; leader={exc.leader_addr}"
                         })
+                    elif isinstance(exc, RateLimitError):
+                        # Load-shed submission: 429 + the bucket's actual
+                        # deficit as the Retry-After hint (admission.py).
+                        self._respond(
+                            429, {"error": str(exc)},
+                            headers={
+                                "Retry-After": f"{exc.retry_after:.3f}"
+                            },
+                        )
                     else:
                         self._respond(500, {"error": str(exc)})
 
@@ -1600,6 +1622,15 @@ class HTTPAPIServer:
             if server is None:
                 raise HTTPError(501, "agent is not running a server")
             return server.observatory.health_report()
+
+        if path == "/v1/overload" and method == "GET":
+            # The control loop's full decision surface: state machine,
+            # pressure windows, hysteresis budget, and per-actuator
+            # stats (obs/controller.py).
+            server = self.agent.server
+            if server is None:
+                raise HTTPError(501, "agent is not running a server")
+            return server.overload_controller.report()
 
         if path == "/v1/metrics" and method == "GET":
             snap = self.agent.metrics()

@@ -25,6 +25,15 @@ Seam catalog (ctx keys each seam passes):
 - ``heartbeat.ttl``   — node                       (server TTL grant)
 - ``client.heartbeat``— node                       (client heartbeat loop)
 - ``driver.start`` / ``driver.wait`` / ``driver.stop`` — driver, task
+- ``controller.actuate`` — target                  (overload actuation:
+  ``error`` = the actuation is lost; the controller stays in its old
+  state and re-drives the same target next observatory tick)
+- ``broker.shed``     — enabled                    (shed toggle lost)
+- ``blocked.unblock`` — cls                        (capacity wakeup
+  lost: blocked evals stay parked until the next capacity event)
+- ``admission.gate``  — namespace                  (``error`` = spurious
+  429: a submission with bucket capacity is rejected anyway —
+  exercises the client's Retry-After path)
 
 Fault kinds each seam understands (others are ignored there):
 

@@ -377,9 +377,287 @@ def wedged_driver_during_drain(seed: int, workdir: str) -> Dict:
     return report
 
 
+# ----------------------------------------------------------------------
+# Overload scenarios (ISSUE 16): the control loop under chaos
+# ----------------------------------------------------------------------
+
+def _overload_tuned_config():
+    """Controller thresholds scaled down so a test-sized flash crowd
+    (hundreds of evals, one worker) crosses them within a couple of
+    observatory ticks — same state machine, compressed constants."""
+    from ..obs import OverloadConfig
+
+    return OverloadConfig(
+        gate_enter=0.03, gate_exit=0.012,
+        shed_enter=0.05, shed_exit=0.025,
+        window_fast=0.6, window_slow=3.0,
+        min_dwell=0.4, cooldown=0.2,
+        max_flips=8, flip_window=20.0,
+        shed_priority_floor=50, shed_delay=0.3, shed_jitter=0.5,
+        retry_after=0.5,
+    )
+
+
+def _overload_cluster(n: int = 3):
+    """3-server raft control plane tuned for overload scenarios: one
+    worker (so a crowd actually builds backlog), a fast observatory
+    tick, compressed controller thresholds, and a small admission
+    bucket the crowd can empty."""
+    from ..api.agent import Agent, AgentConfig
+    from ..server import ServerConfig
+
+    ports = _free_ports(n)
+    addrs = [f"http://127.0.0.1:{p}" for p in ports]
+    agents = []
+    for i in range(n):
+        agents.append(Agent(AgentConfig(
+            name=f"server-{i}",
+            server_enabled=True,
+            client_enabled=False,
+            http_host="127.0.0.1",
+            http_port=ports[i],
+            server_config=ServerConfig(
+                num_workers=1,
+                heartbeat_min_ttl=60,
+                heartbeat_max_ttl=90,
+                server_id=f"server-{i}",
+                peers=list(addrs),
+                # Roomier than the replication tests: overload runs keep
+                # the GIL busy scheduling, and a spurious election mid-
+                # crowd would make the goodput numbers lie.
+                election_timeout=(0.5, 1.0),
+                raft_heartbeat_interval=0.15,
+                slo_interval=0.15,
+                overload_config=_overload_tuned_config(),
+                admission_rate=50.0,
+                admission_burst=50.0,
+            ),
+        )))
+    for a in agents:
+        a.start()
+    return agents, addrs
+
+
+def _drain_rate(server, n_evals: int, timeout: float = 60.0):
+    """Submit-side throughput: wait for the broker to drain and return
+    (evals/s over the drain, drained_ok)."""
+    start = time.time()
+    ok = _wait(lambda: _evals_settled(server), timeout=timeout)
+    elapsed = max(time.time() - start, 1e-6)
+    return n_evals / elapsed, ok
+
+
+def _submit_crowd(server, count: int, offset: int = 0,
+                  low_priority_every: int = 2):
+    """Blast ``count`` registrations as fast as the gate allows; every
+    ``low_priority_every``-th job is priority-10 batch work (shed bait —
+    the default floor only defers priority < 50).  Returns
+    (admitted, rejected)."""
+    from ..server.admission import RateLimitError
+
+    admitted = rejected = 0
+    for i in range(count):
+        job = _small_job(offset + i)
+        if low_priority_every and i % low_priority_every == 0:
+            job.priority = 10
+        try:
+            server.submit_job(job)
+            admitted += 1
+        except RateLimitError:
+            rejected += 1
+    return admitted, rejected
+
+
+def flash_crowd_flapping_partition(
+    seed: int, workdir: str, crowd: int = 200, second_wave: int = 100
+) -> Dict:
+    """A flash crowd hits the leader while one leader→follower link
+    flaps (probabilistic drops).  The controller must engage shedding
+    within its fast pressure window, goodput must not collapse while
+    shedding, state flips must stay inside the hysteresis budget, and
+    the cluster must return to steady with store invariants intact.
+
+    ``second_wave`` submissions arrive paced *after* engagement — the
+    shed path only defers evals enqueued while shedding is on, so the
+    continuing-arrivals wave is what exercises it (set 0 to skip)."""
+    from .. import mock
+
+    report: Dict = {"name": "flash_crowd_flapping_partition", "seed": seed}
+    schedule = [
+        # The flapping partition: one link drops ~35% of sends for the
+        # whole run.  Leadership holds through the second follower.
+        FaultSpec("raft.send", "drop", p=0.35, match={"dst": "@victim"}),
+    ]
+    agents = []
+    try:
+        agents, addrs = _overload_cluster(3)
+        assert _wait(lambda: _leader(agents) is not None, timeout=20)
+        leader = _leader(agents)
+        victim = next(a for a in agents if a is not leader)
+        schedule[0].match = {"dst": victim.rpc_addr}
+        for _ in range(2):
+            leader.server.register_node(mock.node())
+        srv = leader.server
+        ctrl = srv.overload_controller
+
+        # -- warm-up (first-eval JIT compile must not skew rates) ------
+        _submit_crowd(srv, 5, low_priority_every=0)
+        assert _wait(lambda: _evals_settled(srv), timeout=60)
+        # -- pre-overload baseline: a modest burst, fully drained ------
+        n_pre, _ = _submit_crowd(srv, 30, offset=10, low_priority_every=0)
+        pre_rate, drained = _drain_rate(srv, n_pre, timeout=60)
+        assert drained, "baseline burst never drained"
+        report["pre_rate"] = round(pre_rate, 1)
+        _wait(lambda: ctrl.state == "steady", timeout=20)
+        state_pre = ctrl.state
+
+        with injected(seed, schedule) as inj:
+            # -- the flash crowd under the flapping link --------------
+            crowd_start = time.time()
+            admitted, rejected = _submit_crowd(srv, crowd, offset=100)
+            engaged = _wait(
+                lambda: ctrl.state != "steady", timeout=10
+            )
+            t_engage = time.time() - crowd_start
+            state_under_load = ctrl.state
+            # -- continuing arrivals while engaged: paced so the gate's
+            # throttled refill admits a trickle, and the low-priority
+            # half of what lands gets shed-deferred.
+            wave2_admitted = wave2_rejected = 0
+            for i in range(second_wave):
+                a2, r2 = _submit_crowd(
+                    srv, 1, offset=1000 + i,
+                    low_priority_every=1 if i % 2 == 0 else 0,
+                )
+                wave2_admitted += a2
+                wave2_rejected += r2
+                time.sleep(0.02)
+            # Goodput over the whole overload phase: everything the
+            # gate admitted, divided by crowd-start → queues-empty.
+            drained = _wait(lambda: _evals_settled(srv), timeout=90)
+            overload_rate = (admitted + wave2_admitted) / max(
+                time.time() - crowd_start, 1e-6
+            )
+            shed_stats = srv.eval_broker.shed_stats()
+            report["faults"] = _fault_rows(inj)
+
+        report.update({
+            "state_pre_crowd": state_pre,
+            "admitted": admitted,
+            "rejected": rejected,
+            "wave2_admitted": wave2_admitted,
+            "wave2_rejected": wave2_rejected,
+            "engaged": engaged,
+            "time_to_engage_s": round(t_engage, 3),
+            "fast_window_s": ctrl.cfg.window_fast,
+            "state_under_load": state_under_load,
+            "crowd_drained": drained,
+            "overload_rate": round(overload_rate, 1),
+            "goodput_ratio": round(
+                overload_rate / pre_rate, 3
+            ) if pre_rate > 0 else None,
+            "total_shed": shed_stats["total_shed"],
+        })
+
+        # -- recovery: de-escalate back to steady ----------------------
+        recovered = _wait(lambda: ctrl.state == "steady", timeout=30)
+        report["recovered"] = recovered
+        report["flips"] = ctrl.flips_total
+        report["flips_suppressed"] = ctrl.flips_suppressed
+        report["flip_budget"] = ctrl.cfg.max_flips
+        report["violations"] = check_store(srv)
+    finally:
+        for a in agents:
+            try:
+                a.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
+def breach_while_leader_killed(seed: int, workdir: str) -> Dict:
+    """Kill the leader while its controller is actively shedding.  The
+    dying leader must release its actuators on the way down, the
+    survivors must elect, the new leader must keep serving writes (and
+    re-judge overload from its own restored backlog), and the cluster
+    must end steady with invariants intact."""
+    from .. import mock
+
+    report: Dict = {"name": "breach_while_leader_killed", "seed": seed}
+    agents = []
+    try:
+        agents, addrs = _overload_cluster(3)
+        assert _wait(lambda: _leader(agents) is not None, timeout=20)
+        leader = _leader(agents)
+        for _ in range(2):
+            leader.server.register_node(mock.node())
+        srv = leader.server
+        ctrl = srv.overload_controller
+
+        # Warm up the scheduler, then drive the controller out of
+        # steady with a crowd.
+        _submit_crowd(srv, 5, low_priority_every=0)
+        assert _wait(lambda: _evals_settled(srv), timeout=60)
+        admitted, rejected = _submit_crowd(srv, 200, offset=10)
+        engaged = _wait(lambda: ctrl.state != "steady", timeout=10)
+        report.update({
+            "admitted": admitted,
+            "rejected": rejected,
+            "engaged_pre_kill": engaged,
+            "state_pre_kill": ctrl.state,
+            "shed_pre_kill": srv.eval_broker.shed_stats()["total_shed"],
+        })
+
+        # Kill it mid-shed — no drain, no goodbye.
+        leader.shutdown()
+        # shutdown() → overload_controller.reset(): the dead leader's
+        # gate must not stay engaged (a zombie 429 source).
+        report["old_leader_released"] = (
+            ctrl.state == "steady"
+            and srv.admission_gate.factor == 1.0
+        )
+
+        survivors = [a for a in agents if a is not leader]
+        assert _wait(
+            lambda: _leader(survivors) is not None, timeout=30
+        ), "survivors failed to elect"
+        new_leader = _leader(survivors)
+        nsrv = new_leader.server
+        # The new leader serves writes immediately (its own gate starts
+        # steady — overload state is leader-local, not replicated).
+        post_ev = nsrv.submit_job(_small_job(999))
+        report["post_kill_eval"] = post_ev.id if post_ev else None
+        report["new_leader_state_initial"] = (
+            nsrv.overload_controller.state
+        )
+
+        assert _wait(lambda: _evals_settled(nsrv), timeout=60)
+        recovered = _wait(
+            lambda: nsrv.overload_controller.state == "steady",
+            timeout=30,
+        )
+        report["recovered"] = recovered
+        report["new_leader_flips"] = nsrv.overload_controller.flips_total
+        report["flip_budget"] = nsrv.overload_controller.cfg.max_flips
+        violations = wait_converged(
+            [a.server for a in survivors], timeout=20
+        )
+        violations += check_store(nsrv)
+        report["violations"] = violations
+    finally:
+        for a in agents:
+            try:
+                a.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+    return report
+
+
 SCENARIOS: Dict[str, Callable[..., Dict]] = {
     "leader_kill_mid_apply": leader_kill_mid_apply,
     "wal_truncation_sweep": wal_truncation_sweep,
     "partition_then_heal": partition_then_heal,
     "wedged_driver_during_drain": wedged_driver_during_drain,
+    "flash_crowd_flapping_partition": flash_crowd_flapping_partition,
+    "breach_while_leader_killed": breach_while_leader_killed,
 }

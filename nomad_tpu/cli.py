@@ -719,6 +719,8 @@ def cmd_slo(args) -> int:
     client = _client(args)
     if args.health:
         _print(client.health())
+    elif args.overload:
+        _print(client.overload())
     else:
         _print(client.slo())
     return 0
@@ -1032,6 +1034,8 @@ def build_parser() -> argparse.ArgumentParser:
     slo = sub.add_parser("slo", help="SLO report (burn rates, status)")
     slo.add_argument("--health", action="store_true",
                      help="show the composite health report instead")
+    slo.add_argument("--overload", action="store_true",
+                     help="show the overload controller report instead")
     slo.set_defaults(fn=cmd_slo)
 
     tr = sub.add_parser("trace", help="eval-lifecycle tracing").add_subparsers(

@@ -13,11 +13,14 @@ Three AST passes over the production tree, one runtime sanitizer:
 * **chaos seams** (:mod:`.chaospass`, rules ``C001``–``C004``) — the
   CHAOS.md seam catalog and retry surface cross-checked against the
   injector call sites and the tests that exercise them.
-* **observability** (:mod:`.obspass`, rules ``O001``–``O002``) — every
+* **observability** (:mod:`.obspass`, rules ``O001``–``O003``) — every
   injector call site must emit a trace event on the same path, so chaos
-  faults are visible in flight-recorder dumps; and every ``SLOSpec``'s
+  faults are visible in flight-recorder dumps; every ``SLOSpec``'s
   literal objective must resolve to a metric the code actually
-  registers, so a renamed timer can't silently disarm an SLO.
+  registers, so a renamed timer can't silently disarm an SLO; and every
+  overload-actuator decision site (``set_gate_level``/``set_shedding``)
+  must emit a trace event AND increment a ``nomad.*`` counter, so
+  control-loop flips stay auditable against the 429s/sheds they cause.
 * **TSan-lite** (:mod:`.tsan`) — the runtime half: lockset-checked
   shared-state wrappers enabled under the seeded chaos scenarios.
 

@@ -1,4 +1,4 @@
-"""Observability pass (rules O001, O002).
+"""Observability pass (rules O001–O003).
 
 The flight recorder is only as good as its coverage: a chaos seam that
 fires without leaving a trace event is invisible in the post-mortem
@@ -25,6 +25,16 @@ emit a trace event on the same path** — and this pass enforces it:
   set is collected from the whole tree, so the check is a ``run``-level
   pass; :func:`collect_metric_names` + :func:`analyze_slo_objectives`
   expose the two halves for fixtures.
+
+* **O003 silent actuator decision** — a call site of an overload
+  actuator (``set_gate_level(...)`` / ``set_shedding(...)``) whose
+  enclosing function does not BOTH emit a trace event and increment a
+  literal ``nomad.*`` counter (``.incr("nomad....")``).  The control
+  loop's whole defense against oscillation arguments is an audit trail:
+  a gate level or shed toggle that moves without a trace event and a
+  counter can't be correlated with the 429s/deferrals it caused, and
+  "why did throughput halve at 14:03" becomes unanswerable.
+  :func:`analyze_actuators` is the per-module fixture API.
 
 Shares the seam-site discovery with :mod:`.chaospass` (same
 ``INJECT_FUNC_NAMES``, same tree walk) so the two passes can't drift
@@ -238,6 +248,91 @@ def analyze_slo_objectives(
     return findings
 
 
+# -- O003: actuator decisions must trace + count ------------------------
+
+# The overload actuator surface: any attribute/name call of these is a
+# control decision taking effect (obs/controller.py's _actuate_* sites).
+ACTUATOR_CALL_NAMES = frozenset({"set_gate_level", "set_shedding"})
+
+
+def _actuator_calls(body: ast.AST) -> List[Tuple[str, int]]:
+    """(actuator name, line) for calls directly inside ``body`` (nested
+    defs excluded — same scoping discipline as the seam walk)."""
+    out: List[Tuple[str, int]] = []
+    for child in ast.iter_child_nodes(body):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(child, ast.Call):
+            fname = _call_name(child)
+            if fname in ACTUATOR_CALL_NAMES:
+                out.append((fname, child.lineno))
+        out.extend(_actuator_calls(child))
+    return out
+
+
+def _incrs_registered_counter(node: ast.AST) -> bool:
+    """Does this subtree call ``.incr`` with a literal ``nomad.*`` name?
+    Nested defs are not descended into."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if (
+            isinstance(child, ast.Call)
+            and _call_name(child) == "incr"
+            and (first := _first_str_arg(child)) is not None
+            and first.startswith("nomad.")
+        ):
+            return True
+        if _incrs_registered_counter(child):
+            return True
+    return False
+
+
+def analyze_actuators(rel: str, src: str) -> List[Finding]:
+    """Pure per-module O003 check — the test fixture API."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+
+    funcs: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                funcs.append((qual, child))
+                visit(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}.{child.name}" if prefix else child.name)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+
+    findings: List[Finding] = []
+    for qual, scope in [("<module>", tree)] + funcs:
+        calls = _actuator_calls(scope)
+        if not calls:
+            continue
+        missing = []
+        if not _emits_trace(scope):
+            missing.append("a trace event")
+        if not _incrs_registered_counter(scope):
+            missing.append('a literal `nomad.*` counter incr')
+        if not missing:
+            continue
+        for fname, line in calls:
+            findings.append(Finding(
+                "O003", rel, line, qual,
+                f"overload actuator `{fname}` moves here but `{qual}` "
+                f"never emits {' or '.join(missing)} — the control "
+                f"decision is unauditable (no way to line the flip up "
+                f"with the 429s/sheds it caused)",
+            ))
+    return findings
+
+
 def _walk_sources(root: str):
     pkg = os.path.join(root, "nomad_tpu")
     for dirpath, dirnames, filenames in os.walk(pkg):
@@ -264,5 +359,6 @@ def run(root: str) -> List[Finding]:
     for rel, src in sources:
         if not rel.endswith(_SKIP_FILES):
             findings.extend(analyze_module(rel, src))
+            findings.extend(analyze_actuators(rel, src))
         findings.extend(analyze_slo_objectives(rel, src, registered))
     return findings

@@ -7,8 +7,19 @@ leader's :class:`~.evaluator.SLOObservatory`, fanned out as ``SLO`` /
 ``Health`` events on the store's EventBroker, and surfaced at
 ``GET /v1/slo`` / ``GET /v1/health`` and in the ``nomad top``
 dashboard (:mod:`.top`).  See OBSERVABILITY.md.
+
+The loop is closed by :class:`~.controller.OverloadController`
+(``GET /v1/overload``): pressure + burn rates drive admission gating,
+priority shedding, and report the DRR dequeue fairness stats.
 """
 
+from .controller import (
+    OverloadConfig,
+    OverloadController,
+    STATE_GATING,
+    STATE_SHEDDING,
+    STATE_STEADY,
+)
 from .evaluator import SLOObservatory, TOPIC_HEALTH, TOPIC_SLO
 from .health import compute_health, collect_signals
 from .slo import (
@@ -21,9 +32,14 @@ from .slo import (
 )
 
 __all__ = [
+    "OverloadConfig",
+    "OverloadController",
     "SLOEngine",
     "SLOObservatory",
     "SLOSpec",
+    "STATE_GATING",
+    "STATE_SHEDDING",
+    "STATE_STEADY",
     "STATUS_BREACHED",
     "STATUS_OK",
     "STATUS_PENDING",

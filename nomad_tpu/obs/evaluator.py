@@ -144,6 +144,20 @@ class SLOObservatory:
             self._last_health = report
             self._last_signals = signals
             self.ticks += 1
+        # Close the control loop: the same tick that measured pressure
+        # drives the actuators (sense → decide → act share one clock, so
+        # hysteresis windows in the controller line up with burn windows
+        # here).  Guarded — a controller bug must not stop SLO evaluation.
+        ctrl = getattr(srv, "overload_controller", None)
+        if ctrl is not None and getattr(
+            srv.config, "overload_enabled", False
+        ):
+            try:
+                ctrl.step(
+                    report, breached=self.engine.breached(), now=now
+                )
+            except Exception:  # noqa: BLE001
+                log.exception("overload controller step failed")
         if prev is not None and prev["status"] != report["status"]:
             events.append(Event(
                 topic=TOPIC_HEALTH,
@@ -215,6 +229,11 @@ class SLOObservatory:
         self, spec: SLOSpec, old: str, new: str, now: float
     ) -> Event:
         st = self.engine.state(spec.name)
+        if st is not None:
+            fast, _ = self.engine._burn(st, spec.windows[0], now)
+            slow, _ = self.engine._burn(st, spec.windows[1], now)
+        else:
+            fast = slow = 0.0
         return Event(
             topic=TOPIC_SLO,
             type="SLOBreached" if new == STATUS_BREACHED else "SLORecovered",
@@ -226,6 +245,11 @@ class SLOObservatory:
                 "target": spec.target,
                 "op": spec.op,
                 "value": round(st.last_value, 4) if st else None,
+                # Burn rates at transition time — the rolling windows
+                # drain fast, so a late reader of /v1/slo can't recover
+                # these from a live query.
+                "burn_rate_fast": round(fast, 4),
+                "burn_rate_slow": round(slow, 4),
                 "from": old,
                 "to": new,
                 "at": now,

@@ -13,6 +13,7 @@ Layout:
     evals/s     : 512.4        broker ready/unacked/pending: 0/3/1
     blocked     : 0            plan queue: 0   applied/s: 511.9
     pipeline    : 3/8 in flight   lane fill: 0.82   stale: 0
+    actuator: steady    pressure 0.02/0.01  gate 1.00  429s 0 …
     phase                     count      p50 ms      p99 ms
       broker.queue_wait       51234       0.210       1.820
       …
@@ -79,6 +80,7 @@ def render(
     interval: float = 2.0,
     address: str = "",
     events: Optional[List[str]] = None,
+    overload: Optional[Dict[str, Any]] = None,
 ) -> str:
     lines: List[str] = []
     h = health or {}
@@ -105,6 +107,21 @@ def render(
         f"  lane fill {_num(metrics, 'nomad.coalescer.lane_fill_ratio'):.2f}"
         f"  stale {int(_num(metrics, 'nomad.coalescer.stale_dispatches'))}"
     )
+    if overload:
+        p = overload.get("pressure", {})
+        act = overload.get("actuators", {})
+        adm = act.get("admission", {})
+        shed = act.get("shed", {})
+        flips = overload.get("flips", {})
+        lines.append(
+            f"actuator: {overload.get('state', '?'):<9}"
+            f" pressure {p.get('fast', 0):.2f}/{p.get('slow', 0):.2f}"
+            f"  gate {adm.get('factor', 1.0):.2f}"
+            f"  429s {int(adm.get('rejected', 0))}"
+            f"  shed {int(shed.get('total_shed', 0))}"
+            f"  flips {int(flips.get('total', 0))}"
+            f" (supp {int(flips.get('suppressed', 0))})"
+        )
     phases = _phase_rows(metrics)
     if phases:
         lines.append(f"{'phase':<30}{'count':>9}{'p50 ms':>10}{'p99 ms':>10}")
@@ -204,10 +221,15 @@ def run_top(
                 health = client.health()
             except Exception:
                 health = None
+            try:
+                overload = client.overload()
+            except Exception:
+                overload = None
             frame = render(
                 metrics, slo, health,
                 prev_metrics=prev, interval=interval,
                 address=client.address, events=list(tail.lines),
+                overload=overload,
             )
             if clear:
                 out.write(CLEAR)

@@ -7,14 +7,81 @@ here until cluster capacity changes. Unblocking is keyed by the node's
 ineligible; a capacity change on a class it has not seen (or any change, if
 the eval *escaped* class hashing) re-enqueues it. Duplicate blocked evals per
 job are tracked and cancelled by the leader.
+
+Re-enqueue ordering is **per-namespace deficit round-robin**, not the
+reference's global FIFO: an unblock event that frees hundreds of one
+tenant's evals (a thundering herd after a big node joins) must not
+front-run every other tenant at equal priority — the broker's ready
+queue is FIFO within a priority band, so the order evals *re-enter* it
+IS the fairness policy.  :class:`_DeficitRoundRobin` keeps a persistent
+per-namespace deficit across unblock rounds, so a namespace that got a
+long run of service in one round starts the next one at the back.
 """
 
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from .. import trace
+from ..chaos.injector import inject
 from ..structs.types import EvalStatus, Evaluation
+
+
+class _DeficitRoundRobin:
+    """Interleave items across namespaces with classic DRR (quantum 1,
+    unit cost): each pass every active namespace's deficit grows by the
+    quantum; a namespace emits items while its deficit covers them.
+    Deficits persist across calls (bounded at ±``_CLAMP``), so heavy
+    service in one unblock round is paid back in the next.
+    """
+
+    _CLAMP = 64.0
+
+    def __init__(self, quantum: float = 1.0):
+        self.quantum = quantum
+        self._deficit: Dict[str, float] = {}
+        self.rounds = 0
+        self.served: Dict[str, int] = {}
+
+    def interleave(self, evals: List[Evaluation]) -> List[Evaluation]:
+        if len(evals) <= 1:
+            for ev in evals:
+                self.served[ev.namespace] = self.served.get(ev.namespace, 0) + 1
+            return list(evals)
+        queues: "OrderedDict[str, List[Evaluation]]" = OrderedDict()
+        for ev in evals:
+            queues.setdefault(ev.namespace, []).append(ev)
+        # Rotate the starting namespace by accumulated service so the
+        # same tenant does not lead every round.
+        order = sorted(queues, key=lambda ns: self.served.get(ns, 0))
+        out: List[Evaluation] = []
+        idx = {ns: 0 for ns in queues}
+        while len(out) < len(evals):
+            self.rounds += 1
+            progressed = False
+            for ns in order:
+                q = queues[ns]
+                if idx[ns] >= len(q):
+                    continue
+                credit = self._deficit.get(ns, 0.0) + self.quantum
+                while idx[ns] < len(q) and credit >= 1.0:
+                    out.append(q[idx[ns]])
+                    idx[ns] += 1
+                    credit -= 1.0
+                    progressed = True
+                    self.served[ns] = self.served.get(ns, 0) + 1
+                self._deficit[ns] = max(
+                    -self._CLAMP, min(self._CLAMP, credit)
+                ) if idx[ns] < len(q) else 0.0
+            if not progressed:
+                # Every namespace is deficit-starved this pass; the next
+                # pass adds another quantum each — guaranteed progress.
+                continue
+        # Namespaces fully drained reset their deficit (classic DRR:
+        # an empty queue forfeits its credit, preventing burst hoarding).
+        return out
 
 
 class BlockedEvals:
@@ -31,7 +98,15 @@ class BlockedEvals:
         # Classes whose capacity changed while nothing was blocked — lets a
         # Block() racing an Unblock() see the change (b.unblockIndexes).
         self._unblock_indexes: Dict[str, int] = {}
-        self.stats = {"total_blocked": 0, "total_escaped": 0, "total_quota_limit": 0}
+        # Per-namespace fair re-enqueue (module docstring): persistent
+        # across unblock rounds, reset with set_enabled(False).
+        self._drr = _DeficitRoundRobin()
+        self.stats = {
+            "total_blocked": 0,
+            "total_escaped": 0,
+            "total_quota_limit": 0,
+            "total_unblocked": 0,
+        }
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -42,6 +117,7 @@ class BlockedEvals:
                 self._jobs.clear()
                 self._duplicates.clear()
                 self._unblock_indexes.clear()
+                self._drr = _DeficitRoundRobin()
 
     # ------------------------------------------------------------------
 
@@ -92,6 +168,14 @@ class BlockedEvals:
     def unblock(self, computed_class: str, index: int) -> None:
         """Capacity changed on ``computed_class`` (node registered, alloc
         stopped, drain lifted...). Re-enqueue everything that could now fit."""
+        spec = inject("blocked.unblock", cls=computed_class)
+        if spec is not None and spec.kind == "error":
+            # Capacity wakeup lost: evals stay blocked until the next
+            # capacity event or the leader's periodic unblock sweep.
+            trace.event("seam.blocked.unblock", cls=computed_class,
+                        applied=False)
+            return
+        trace.event("seam.blocked.unblock", cls=computed_class, applied=True)
         with self._lock:
             if not self._enabled:
                 return
@@ -137,12 +221,16 @@ class BlockedEvals:
             self._enqueue_unblocked_locked(unblock)
 
     def _enqueue_unblocked_locked(self, evals: List[Evaluation]) -> None:
-        for ev in evals:
+        # Deficit round-robin across namespaces: the broker's ready queue
+        # is FIFO within a priority band, so this re-enqueue order is the
+        # inter-tenant fairness policy (module docstring).
+        for ev in self._drr.interleave(evals):
             key = (ev.namespace, ev.job_id)
             if self._jobs.get(key) == ev.id:
                 del self._jobs[key]
             requeued = ev.copy()
             requeued.status = EvalStatus.PENDING.value
+            self.stats["total_unblocked"] += 1
             self._enqueue(requeued)
 
     # ------------------------------------------------------------------
@@ -165,3 +253,14 @@ class BlockedEvals:
     def blocked_count(self) -> int:
         with self._lock:
             return len(self._captured) + len(self._escaped)
+
+    def fairness_stats(self) -> Dict[str, object]:
+        """DRR service accounting for /v1/overload's dequeue actuator row."""
+        with self._lock:
+            return {
+                "policy": "deficit-round-robin",
+                "quantum": self._drr.quantum,
+                "rounds": self._drr.rounds,
+                "served": dict(self._drr.served),
+                "total_unblocked": self.stats["total_unblocked"],
+            }

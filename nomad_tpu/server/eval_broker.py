@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import trace
+from ..chaos.injector import inject
 from ..structs.types import EvalStatus, Evaluation
 
 # Reference: nomad/config.go — EvalNackTimeout / EvalDeliveryLimit defaults.
@@ -107,12 +109,25 @@ class EvalBroker:
         self._shutdown = False
         self._timer_thread: Optional[threading.Thread] = None
 
+        # Priority-aware shedding (OverloadController actuator): while
+        # engaged, evals below the priority floor are deferred into the
+        # delay heap with a jittered re-enqueue delay instead of landing
+        # ready — backpressure the dispatch side can see, not backlog.
+        self._shed_enabled = False
+        self._shed_floor = 0
+        self._shed_delay = 2.0
+        self._shed_jitter = 0.5
+        self._shed_max_defers = 8  # aging: progress even under sustained shed
+        self._shed_counts: Dict[str, int] = {}
+        self._shed_rng = random.Random()
+
         self.stats = {
             "total_ready": 0,
             "total_unacked": 0,
             "total_pending": 0,
             "total_waiting": 0,
             "total_failed_deliveries": 0,
+            "total_shed": 0,
         }
 
     # ------------------------------------------------------------------
@@ -154,6 +169,7 @@ class EvalBroker:
         self._delayed = []
         self._tracked.clear()
         self._enqueue_ts.clear()
+        self._shed_counts.clear()
 
     @property
     def enabled(self) -> bool:
@@ -188,6 +204,8 @@ class EvalBroker:
         self._enqueue_ready_locked(ev)
 
     def _enqueue_ready_locked(self, ev: Evaluation) -> None:
+        if self._maybe_shed_locked(ev):
+            return
         # Queue-wait starts at first readiness (per-job pending keeps its
         # original stamp; a nack redelivery re-stamps from requeue).
         self._enqueue_ts.setdefault(ev.id, time.time())
@@ -205,6 +223,70 @@ class EvalBroker:
             self._job_tokens[key] = ev.id
         queue = ev.type or "service"
         self._ready.setdefault(queue, _ReadyQueue()).push(ev)
+
+    # ------------------------------------------------------------------
+    # Priority-aware shedding (OverloadController actuator)
+    # ------------------------------------------------------------------
+
+    def set_shedding(
+        self,
+        enabled: bool,
+        priority_floor: int = 50,
+        delay: float = 2.0,
+        jitter: float = 0.5,
+    ) -> None:
+        """Engage/release shed mode.  Called by OverloadController
+        actuator methods (lint O003 holds those to trace + counter
+        emission); the chaos seam here lets scenarios lose or slow the
+        actuation itself."""
+        spec = inject("broker.shed", enabled=str(enabled))
+        if spec is not None and spec.kind == "error":
+            trace.event("seam.broker.shed", applied=False)
+            return  # actuation lost — controller re-drives next tick
+        trace.event(
+            "seam.broker.shed", applied=True, enabled=enabled,
+            floor=priority_floor,
+        )
+        with self._lock:
+            self._shed_enabled = enabled
+            self._shed_floor = priority_floor
+            self._shed_delay = max(delay, 0.05)
+            self._shed_jitter = max(jitter, 0.0)
+            if not enabled:
+                self._shed_counts.clear()
+                # Promote anything the delay heap is only holding for
+                # shed reasons at its scheduled time — no early flush
+                # needed; the watcher drains naturally.
+            self._cond.notify_all()
+
+    def _maybe_shed_locked(self, ev: Evaluation) -> bool:
+        """Defer ``ev`` with a jittered delay when shed mode is on and
+        its priority sits below the floor.  Ages out after
+        ``_shed_max_defers`` deferrals so sustained overload still
+        makes (slow) progress on low-priority work."""
+        if not self._shed_enabled or ev.priority >= self._shed_floor:
+            return False
+        defers = self._shed_counts.get(ev.id, 0)
+        if defers >= self._shed_max_defers:
+            return False
+        self._shed_counts[ev.id] = defers + 1
+        self.stats["total_shed"] += 1
+        if self.metrics is not None:
+            self.metrics.incr("nomad.broker.evals_shed")
+        spread = 1.0 + self._shed_jitter * (2.0 * self._shed_rng.random() - 1.0)
+        deadline = time.time() + max(self._shed_delay * spread, 0.05)
+        heapq.heappush(self._delayed, (deadline, next(self._seq), ev))
+        return True
+
+    def shed_stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "enabled": self._shed_enabled,
+                "priority_floor": self._shed_floor,
+                "delay_s": self._shed_delay,
+                "total_shed": self.stats["total_shed"],
+                "deferred_now": len(self._shed_counts),
+            }
 
     # ------------------------------------------------------------------
     # Dequeue / Ack / Nack
@@ -280,6 +362,7 @@ class EvalBroker:
             self._attempts.pop(eval_id, None)
             self._tracked.discard(eval_id)
             self._enqueue_ts.pop(eval_id, None)
+            self._shed_counts.pop(eval_id, None)
             ev = un.eval
             key = (ev.namespace, ev.job_id)
             if self._job_tokens.get(key) == ev.id:
@@ -401,6 +484,7 @@ class EvalBroker:
                     out.append(ev)
                     self._tracked.discard(ev.id)
                     self._enqueue_ts.pop(ev.id, None)
+                    self._shed_counts.pop(ev.id, None)
                     key = (ev.namespace, ev.job_id)
                     if self._job_tokens.get(key) == ev.id:
                         del self._job_tokens[key]

@@ -227,7 +227,9 @@ class PeriodicDispatcher:
         child.parent_id = job.id
         child.periodic = None
         self.server.record_periodic_launch(job.namespace, job.id, launch_time)
-        self.server.submit_job(child)
+        # internal: periodic children are server-originated — the load
+        # gate covers external register/dispatch only.
+        self.server.submit_job(child, internal=True)
 
     def _child_running(self, job: Job) -> bool:
         store = self.server.store

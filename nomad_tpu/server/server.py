@@ -100,6 +100,16 @@ class ServerConfig:
     slo_enabled: bool = True
     slo_interval: float = 1.0
     slo_specs: Optional[List] = None
+    # Overload control loop (obs/controller.py): the observatory tick
+    # drives admission gating + broker shedding off the composite
+    # pressure score.  overload_config None = NOMAD_TPU_OVERLOAD_* env
+    # defaults; admission_rate/burst None = NOMAD_TPU_OVERLOAD_RATE /
+    # _BURST (500/s, 1000) per-namespace token buckets (rate <= 0
+    # disables volumetric limiting).
+    overload_enabled: bool = True
+    overload_config: Optional[object] = None
+    admission_rate: Optional[float] = None
+    admission_burst: Optional[float] = None
 
 
 class Server:
@@ -173,6 +183,22 @@ class Server:
             self,
             specs=self.config.slo_specs,
             interval=self.config.slo_interval,
+        )
+
+        # Overload control loop: gate + controller are constructed always
+        # (the /v1/overload surface answers even when the loop is off);
+        # the observatory tick only steps the controller on leaders with
+        # overload_enabled.
+        from ..obs.controller import OverloadController
+        from .admission import AdmissionGate
+
+        self.admission_gate = AdmissionGate(
+            rate=self.config.admission_rate,
+            burst=self.config.admission_burst,
+            metrics=self.metrics,
+        )
+        self.overload_controller = OverloadController(
+            self, config=self.config.overload_config
         )
 
         self._index_lock = threading.Lock()
@@ -364,6 +390,9 @@ class Server:
         self.drainer.stop()
         self.periodic.stop()
         self.observatory.stop()
+        # Release the actuators: a demoted leader must not leave the
+        # cluster gated/shedding on stale pressure it can no longer see.
+        self.overload_controller.reset()
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -374,6 +403,7 @@ class Server:
         self.drainer.stop()
         self.periodic.stop()
         self.observatory.stop()
+        self.overload_controller.reset()
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()
@@ -403,13 +433,21 @@ class Server:
     # Job RPCs (nomad/job_endpoint.go:80 Register, :797 Deregister)
     # ------------------------------------------------------------------
 
-    def submit_job(self, job: Job) -> Optional[Evaluation]:
+    def submit_job(
+        self, job: Job, internal: bool = False
+    ) -> Optional[Evaluation]:
         # Admission pipeline (job_endpoint_hooks.go): mutate
         # (canonicalize + implied constraints), then validate — rejects
         # before anything journals.
         from .admission import admit
 
         admit(job)
+        # Load gate (after canonicalize so namespace is filled): external
+        # registers/dispatches pay the token bucket; internal resubmits
+        # (periodic children) bypass it — shedding them would silently
+        # drop scheduled work the server itself originated.
+        if not internal:
+            self.admission_gate.check(job.namespace, job.priority)
         # An exclusive-writer volume cannot back more than one alloc.
         for tg in job.task_groups:
             for vreq in (tg.volumes or {}).values():
@@ -914,7 +952,9 @@ class Server:
             return None
         reverted = target.copy()
         reverted.stop = False
-        return self.submit_job(reverted)
+        # Revert is a remediation the deployment watcher may trigger
+        # automatically — never load-shed the path back to a good version.
+        return self.submit_job(reverted, internal=True)
 
     def pause_deployment(self, deployment_id: str, pause: bool) -> None:
         """Pause/resume a rolling update (Deployment.Pause,
@@ -1035,7 +1075,9 @@ class Server:
                     )
             updated = job.copy()
             updated.lookup_task_group(group).count = count
-            ev = self.submit_job(updated)
+            # Scale mutates an already-admitted job (autoscaler or
+            # operator); the load gate covers register/dispatch only.
+            ev = self.submit_job(updated, internal=True)
         self.store.record_scaling_event(
             self.next_index(), namespace, job_id, group,
             ScalingEvent(

@@ -299,6 +299,10 @@ class TestChaosBreachEndToEnd:
                 assert breach["Key"] == "placement_latency_p99_ms"
                 assert breach["Payload"]["value"] > 5.0
                 assert breach["Payload"]["to"] == "breached"
+                # Burn rate asserted from the breach-time payload: the
+                # fast window is only 0.4s wide, so by the time the HTTP
+                # queries below land it may legitimately have drained.
+                assert breach["Payload"]["burn_rate_fast"] > 2.0
 
                 # Health must reflect the burned budget even though the
                 # queues themselves are calm.
@@ -310,7 +314,9 @@ class TestChaosBreachEndToEnd:
                 row = [s for s in rep["slos"]
                        if s["name"] == "placement_latency_p99_ms"][0]
                 assert row["status"] == "breached"
-                assert row["burn_rate_fast"] > 2.0
+                # Live-query burn rate is a rolling-window read — only
+                # its shape is stable this long after the last sample.
+                assert row["burn_rate_fast"] >= 0.0
 
                 # The breach auto-dumped a flight record carrying the
                 # breached SLO and the chaos seed — the replayable

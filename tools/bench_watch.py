@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -48,22 +49,52 @@ PROBE_TIMEOUT = env_int("BENCH_PROBE_TIMEOUT", 150)
 # dies between probe and pipelined phase).
 BENCH_TIMEOUT = env_int("BENCH_WATCH_BENCH_TIMEOUT", 1800)
 
+# Probes/benches that had to be SIGKILLed (wedged tunnel analog).  The
+# count rides into the ledger entry (``probe_wedged``) so wedge frequency
+# is trendable next to the numbers it delayed.
+WEDGED = {"probe": 0, "bench": 0}
+
+
+def _run_reaped(cmd: list, timeout: int, env: dict | None = None):
+    """Run ``cmd`` in its own process group; on timeout SIGKILL the whole
+    group.  ``subprocess.run``'s timeout kill only signals the direct
+    child — a wedged tunnel helper (grandchild holding the pipe open)
+    leaves ``communicate()`` hanging forever, which is exactly the state
+    this watcher exists to escape.  Returns (rc, stdout, stderr); rc is
+    None when the group had to be killed."""
+    p = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env, start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            p.kill()
+        try:  # bounded reap: a truly stuck group must not hang US
+            p.communicate(timeout=10)
+        except (subprocess.TimeoutExpired, ValueError):
+            pass
+        return None, "", ""
+
 
 def probe() -> str:
     """One disposable-subprocess backend probe; returns the platform name
     ('tpu', 'cpu', ...) or an error string prefixed with 'err:'."""
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
-            cwd=REPO,
-        )
-    except subprocess.TimeoutExpired:
-        return f"err:hung >{PROBE_TIMEOUT}s (wedged tunnel?)"
-    if p.returncode != 0:
-        return f"err:rc={p.returncode}: {p.stderr.strip()[-200:]}"
-    return p.stdout.strip()
+    rc, out, err = _run_reaped(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        timeout=PROBE_TIMEOUT,
+    )
+    if rc is None:
+        WEDGED["probe"] += 1
+        return f"err:hung >{PROBE_TIMEOUT}s (wedged tunnel?); killed group"
+    if rc != 0:
+        return f"err:rc={rc}: {err.strip()[-200:]}"
+    return out.strip()
 
 
 def run_bench() -> dict | None:
@@ -72,17 +103,21 @@ def run_bench() -> dict | None:
     # The probe already succeeded — skip the bench's own 4-attempt probe
     # ladder so a mid-run wedge fails fast into THIS loop's next attempt.
     env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
-    try:
-        p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT,
-            cwd=REPO, env=env,
+    # The watcher records the ledger entry itself (with the wedge counts
+    # merged in) — the child recording too would double-count the run.
+    env["NOMAD_TPU_BENCH_LEDGER"] = "off"
+    rc, out, err = _run_reaped(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        timeout=BENCH_TIMEOUT, env=env,
+    )
+    if rc is None:
+        WEDGED["bench"] += 1
+        sys.stderr.write(
+            f"bench_watch: bench hung >{BENCH_TIMEOUT}s; killed group\n"
         )
-    except subprocess.TimeoutExpired:
-        sys.stderr.write(f"bench_watch: bench hung >{BENCH_TIMEOUT}s\n")
         return None
     # The result is the LAST json line on stdout (breadcrumbs go to stderr).
-    for line in reversed(p.stdout.strip().splitlines()):
+    for line in reversed(out.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -90,10 +125,34 @@ def run_bench() -> dict | None:
             except json.JSONDecodeError:
                 continue
     sys.stderr.write(
-        f"bench_watch: no JSON in bench output (rc={p.returncode}); "
-        f"stderr tail: {p.stderr.strip()[-300:]}\n"
+        f"bench_watch: no JSON in bench output (rc={rc}); "
+        f"stderr tail: {err.strip()[-300:]}\n"
     )
     return None
+
+
+def _record_ledger(result: dict) -> None:
+    """One ledger entry for this watch (child bench recording is off),
+    with the SIGKILL tallies merged in as ``probe_wedged`` counts."""
+    result = dict(result)
+    result["probe_wedged"] = WEDGED["probe"]
+    result["bench_wedged"] = WEDGED["bench"]
+    ledger_env = os.environ.get("NOMAD_TPU_BENCH_LEDGER", "")
+    if ledger_env.lower() in ("0", "off", "no"):
+        return
+    try:
+        import bench_history
+
+        kw = {"ledger": ledger_env} if ledger_env else {}
+        entry = bench_history.record_run(
+            result, source="bench_watch.py", **kw
+        )
+        for line in bench_history.format_verdicts(entry):
+            sys.stderr.write(line + "\n")
+    except Exception as e:  # noqa: BLE001 — the ledger must never cost a run
+        sys.stderr.write(
+            f"bench_watch ledger skipped: {type(e).__name__}: {e}\n"
+        )
 
 
 def main() -> int:
@@ -146,10 +205,16 @@ def main() -> int:
         )
     except RetryBudgetExceeded:
         sys.stderr.write("bench_watch: budget exhausted, no TPU evidence\n")
+        # Even a fruitless watch leaves its wedge tally in the ledger —
+        # "the tunnel was dead all night" is itself trend data.
+        _record_ledger({
+            "probe_attempts_made": seen["n"],
+        })
         return 1
 
     result["captured_by"] = "tools/bench_watch.py"
     result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    _record_ledger(result)
     tmp = EVIDENCE + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(result, fh, indent=2)

@@ -1,0 +1,271 @@
+"""Synthetic traffic generator for overload benching and live drills.
+
+Generates *arrival schedules* — (time-offset, namespace, priority,
+size) tuples — deterministically from a seed, then replays them against
+a submit function (in-process ``Server.submit_job`` for the bench
+overload phase) or a live cluster over HTTP (``--address``; submissions
+go through ``api/client.py`` and therefore honor 429 + Retry-After like
+any well-behaved client).
+
+Traffic shapes (``--shape``):
+
+* ``poisson``      — homogeneous Poisson arrivals at ``--rate``/s.
+* ``diurnal``      — nonhomogeneous Poisson: the rate ramps along a
+  half-sine from 20% of ``--rate`` to the peak and back (a day
+  compressed into ``--duration`` seconds), sampled by thinning.
+* ``flash_crowd``  — baseline Poisson with a burst window in the middle
+  (``burst_mult``× the rate for 20% of the duration) — the shape the
+  flash-crowd chaos scenario and the controller's fast window exist for.
+
+Job-size mix is Zipf over group counts (most jobs small, a heavy tail
+of wide ones), tenancy is Zipf over ``--tenants`` namespaces (one hot
+tenant, a long tail), and ~30% of arrivals are priority-10 batch work —
+under the default shed floor (50) exactly the slice the broker defers
+first.
+
+Replays are wall-clock faithful: the runner sleeps to each arrival's
+offset (``--time-scale`` compresses), so a 30s diurnal ramp takes 30s.
+Every run returns admit/reject counts and completion stats per shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+SHAPES = ("poisson", "diurnal", "flash_crowd")
+
+
+@dataclass
+class Arrival:
+    t: float            # seconds from schedule start
+    namespace: str
+    priority: int
+    group_count: int    # job width (Zipf-distributed)
+
+
+@dataclass
+class LoadGenConfig:
+    seed: int = 0
+    rate: float = 50.0          # mean arrivals/s (shape modulates)
+    duration: float = 10.0
+    tenants: int = 4            # namespaces: default + tenant-1..n-1
+    zipf_s: float = 1.5         # skew for both tenancy and job width
+    max_group_count: int = 8
+    batch_fraction: float = 0.3  # priority-10 arrivals (shed bait)
+    burst_mult: float = 8.0     # flash_crowd burst amplification
+    burst_window: float = 0.2   # fraction of duration the burst lasts
+
+
+def _zipf_weights(n: int, s: float) -> List[float]:
+    w = [1.0 / (k ** s) for k in range(1, n + 1)]
+    total = sum(w)
+    return [x / total for x in w]
+
+
+class LoadGen:
+    """Deterministic schedule builder + replayer."""
+
+    def __init__(self, config: Optional[LoadGenConfig] = None):
+        self.cfg = config or LoadGenConfig()
+        c = self.cfg
+        self.namespaces = ["default"] + [
+            f"tenant-{i}" for i in range(1, max(c.tenants, 1))
+        ]
+        self._ns_weights = _zipf_weights(len(self.namespaces), c.zipf_s)
+        self._size_weights = _zipf_weights(c.max_group_count, c.zipf_s)
+
+    # -- schedule construction (pure function of seed + shape) ---------
+
+    def _rate_at(self, shape: str, t: float) -> float:
+        c = self.cfg
+        if shape == "poisson":
+            return c.rate
+        if shape == "diurnal":
+            # Half-sine day: trough 20% of peak at both ends.
+            frac = max(0.0, min(t / c.duration, 1.0))
+            return c.rate * (0.2 + 0.8 * math.sin(math.pi * frac))
+        if shape == "flash_crowd":
+            start = c.duration * 0.4
+            end = start + c.duration * c.burst_window
+            return c.rate * (c.burst_mult if start <= t < end else 1.0)
+        raise ValueError(f"unknown shape {shape!r}")
+
+    def _peak_rate(self, shape: str) -> float:
+        c = self.cfg
+        return c.rate * (c.burst_mult if shape == "flash_crowd" else 1.0)
+
+    def schedule(self, shape: str) -> List[Arrival]:
+        """Arrivals via Lewis-Shedler thinning against the peak rate —
+        exact for the homogeneous case, standard for the shaped ones."""
+        import zlib
+
+        c = self.cfg
+        # str hashes are salted per-process; crc32 keeps the schedule a
+        # pure function of (seed, shape) across runs.
+        rng = random.Random(c.seed * 1000003 + zlib.crc32(shape.encode()))
+        peak = self._peak_rate(shape)
+        out: List[Arrival] = []
+        t = 0.0
+        while True:
+            t += rng.expovariate(peak)
+            if t >= c.duration:
+                break
+            if rng.random() * peak > self._rate_at(shape, t):
+                continue  # thinned
+            ns = rng.choices(self.namespaces, weights=self._ns_weights)[0]
+            priority = 10 if rng.random() < c.batch_fraction else 50
+            width = rng.choices(
+                range(1, c.max_group_count + 1),
+                weights=self._size_weights,
+            )[0]
+            out.append(Arrival(
+                t=t, namespace=ns, priority=priority, group_count=width,
+            ))
+        return out
+
+    # -- replay --------------------------------------------------------
+
+    def run(
+        self,
+        submit: Callable[[Arrival], object],
+        shape: str,
+        time_scale: float = 1.0,
+        on_reject: Optional[Callable[[Arrival, Exception], None]] = None,
+    ) -> Dict[str, object]:
+        """Replay ``shape``'s schedule against ``submit``, sleeping to
+        each arrival offset (scaled).  ``submit`` raising is counted as
+        a rejection (RateLimitError / APIError 429); other exceptions
+        propagate.  Returns per-run accounting."""
+        from nomad_tpu.server.admission import RateLimitError
+
+        arrivals = self.schedule(shape)
+        t0 = time.time()
+        admitted = rejected = 0
+        per_ns: Dict[str, List[int]] = {}
+        for a in arrivals:
+            target = t0 + a.t * time_scale
+            delay = target - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            counts = per_ns.setdefault(a.namespace, [0, 0])
+            try:
+                submit(a)
+                admitted += 1
+                counts[0] += 1
+            except RateLimitError as exc:
+                rejected += 1
+                counts[1] += 1
+                if on_reject is not None:
+                    on_reject(a, exc)
+            except Exception as exc:  # noqa: BLE001
+                code = getattr(exc, "code", None)
+                if code != 429:
+                    raise
+                rejected += 1
+                counts[1] += 1
+                if on_reject is not None:
+                    on_reject(a, exc)
+        elapsed = time.time() - t0
+        return {
+            "shape": shape,
+            "offered": len(arrivals),
+            "admitted": admitted,
+            "rejected": rejected,
+            "elapsed_s": round(elapsed, 3),
+            "offered_rate": round(len(arrivals) / max(elapsed, 1e-6), 1),
+            "per_namespace": {
+                ns: {"admitted": a_, "rejected": r_}
+                for ns, (a_, r_) in sorted(per_ns.items())
+            },
+        }
+
+
+def make_job_factory(mock_module):
+    """Arrival → Job using the repo's mock fixtures (in-process runs)."""
+
+    def make(a: Arrival):
+        job = mock_module.job()
+        job.namespace = a.namespace
+        job.priority = a.priority
+        tg = job.task_groups[0]
+        tg.count = a.group_count
+        for t in tg.tasks:
+            t.resources.cpu = 20
+            t.resources.memory_mb = 32
+            t.config = {"run_for": 0}
+        return job
+
+    return make
+
+
+# ----------------------------------------------------------------------
+# CLI: drive a live cluster over HTTP
+# ----------------------------------------------------------------------
+
+def _http_submit(client, counter: Dict[str, int]):
+    """Arrival → register over the API client (retries 429 internally;
+    exhausted retries surface as APIError and count as rejections)."""
+
+    def submit(a: Arrival) -> None:
+        payload = {
+            "ID": f"loadgen-{counter['n']}",
+            "Name": f"loadgen-{counter['n']}",
+            "Namespace": a.namespace,
+            "Priority": a.priority,
+            "Datacenters": ["dc1"],
+            "TaskGroups": [{
+                "Name": "g",
+                "Count": a.group_count,
+                "Tasks": [{
+                    "Name": "t", "Driver": "mock",
+                    "Config": {"run_for": 0},
+                    "Resources": {"CPU": 20, "MemoryMB": 32},
+                }],
+            }],
+        }
+        counter["n"] += 1
+        client.register_job(payload)
+
+    return submit
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="synthetic traffic against a nomad_tpu cluster"
+    )
+    ap.add_argument("--address", default="http://127.0.0.1:4646")
+    ap.add_argument("--token", default="")
+    ap.add_argument("--shape", choices=SHAPES, default="poisson")
+    ap.add_argument("--rate", type=float, default=50.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--time-scale", type=float, default=1.0)
+    ap.add_argument("--burst-mult", type=float, default=8.0)
+    args = ap.parse_args(argv)
+
+    from nomad_tpu.api.client import APIClient
+
+    gen = LoadGen(LoadGenConfig(
+        seed=args.seed, rate=args.rate, duration=args.duration,
+        tenants=args.tenants, burst_mult=args.burst_mult,
+    ))
+    client = APIClient(address=args.address, token=args.token)
+    stats = gen.run(
+        _http_submit(client, {"n": 0}), args.shape,
+        time_scale=args.time_scale,
+    )
+    stats["client_rate_limited"] = client.rate_limited
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
