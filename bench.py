@@ -197,96 +197,101 @@ def init_backend() -> str:
 
 
 # Encoded-matrix disk cache: the sim cluster is a pure function of
-# (N_NODES, CAPACITY, N_ALLOCS, seed) — cache the encoded arrays so repeat
-# runs (and TPU retry loops, where every extra setup second widens the
-# mid-run tunnel-wedge window) start measuring in seconds.  Bump the
-# version when the encoding layout changes.
-_CLUSTER_CACHE_VERSION = 1
+# (N_NODES, CAPACITY, N_ALLOCS, seed) — cache the ENCODED arrays (via
+# NodeMatrix.save_encoded, keyed by its format version) so repeat runs
+# (and TPU retry loops, where every extra setup second widens the mid-run
+# tunnel-wedge window) start measuring in seconds.
+_CLUSTER_CACHE_VERSION = 2
+
+# "warm" (loaded from .bench_cache) or "cold" (built) — stamped into the
+# output JSON so a setup_s number is interpretable on its own.
+CLUSTER_CACHE_STATE = "cold"
 
 
 def _cluster_cache_path() -> str:
+    from nomad_tpu.state.matrix import NodeMatrix
+
     repo = os.path.dirname(os.path.abspath(__file__))
     return os.path.join(
         repo, ".bench_cache",
         f"cluster_v{_CLUSTER_CACHE_VERSION}"
-        f"_{N_NODES}_{CAPACITY}_{N_ALLOCS}.pkl",
+        f"_enc{NodeMatrix.ENCODED_FORMAT}"
+        f"_{N_NODES}_{CAPACITY}_{N_ALLOCS}.npz",
     )
 
 
-def _load_cluster_cache():
-    import pickle
-
-    from nomad_tpu.state.matrix import NodeMatrix
-
-    path = _cluster_cache_path()
-    if not os.path.exists(path):
-        return None
-    try:
-        with open(path, "rb") as fh:
-            state = pickle.load(fh)
-    except Exception as e:  # noqa: BLE001 — stale/corrupt cache: rebuild
-        sys.stderr.write(f"bench: cluster cache unreadable ({e}); rebuild\n")
-        return None
-    m = NodeMatrix(capacity=state["capacity"])
-    m.attrs.slot_of = state["attr_slots"]
-    m.devices.slot_of = state["dev_slots"]
-    m.row_of = state["row_of"]
-    m.node_of = state["node_of"]
-    m._free = state["free"]
-    m._next_row = state["next_row"]
-    m.class_ids = state["class_ids"]
-    m.class_repr = state["class_repr"]
-    m._alloc = state["alloc"]
-    m._dirty.update(m.row_of.values())
-    return m
-
-
-def _save_cluster_cache(m) -> None:
-    import pickle
-
-    path = _cluster_cache_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    state = {
-        "capacity": m.capacity,
-        "attr_slots": m.attrs.slot_of,
-        "dev_slots": m.devices.slot_of,
-        "row_of": m.row_of,
-        "node_of": m.node_of,
-        "free": m._free,
-        "next_row": m._next_row,
-        "class_ids": m.class_ids,
-        "class_repr": m.class_repr,
-        "alloc": m._alloc,
-    }
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        pickle.dump(state, fh, protocol=4)
-    os.replace(tmp, path)
+# The sim attribute patterns below repeat every lcm(4, 6, 32, 3) = 96
+# nodes; rows past the first period are vectorized copies of their
+# representative (same datacenter/class/rack/TPU-type pattern), with only
+# the node-unique columns re-hashed per row.  The old one-upsert-per-node
+# loop walked the full fingerprint/encode path 10K times (~100 s of the
+# r05 artifact's 103 s setup).
+_SIM_PERIOD = 96
 
 
 def build_cluster():
+    global CLUSTER_CACHE_STATE
     from nomad_tpu import mock
-    from nomad_tpu.state.matrix import NodeMatrix, PRIORITY_BUCKETS
+    from nomad_tpu.state.matrix import (
+        NodeMatrix,
+        PRIORITY_BUCKETS,
+        stable_hash,
+    )
 
-    cached = _load_cluster_cache()
-    if cached is not None:
-        return cached
+    path = _cluster_cache_path()
+    if os.path.exists(path):
+        m = NodeMatrix(capacity=CAPACITY)
+        if m.load_encoded(path):
+            CLUSTER_CACHE_STATE = "warm"
+            return m
+        sys.stderr.write("bench: cluster cache stale/unreadable; rebuild\n")
 
     rng = np.random.default_rng(42)
     m = NodeMatrix(capacity=CAPACITY)
-    for i in range(N_NODES):
+
+    def sim_node(i: int):
         node = mock.node()
         node.datacenter = f"dc{i % 4 + 1}"
         node.node_class = f"class-{i % 6}"
         node.attributes = dict(node.attributes)
         node.attributes["rack"] = f"r{i % 32}"
         node.attributes["platform.tpu.type"] = "v5e" if i % 3 else "v5p"
-        m.upsert_node(node)
+        return node
+
+    # Representatives go through the real upsert/encode path (correct
+    # attribute slots, class ids, eligibility).
+    reps = min(_SIM_PERIOD, N_NODES)
+    for i in range(reps):
+        m.upsert_node(sim_node(i))
+
+    host = m.snapshot_host()
+    if N_NODES > reps:
+        rows = np.arange(reps, N_NODES)
+        src = rows % reps  # every modulus above divides _SIM_PERIOD
+        for key in (
+            "totals", "used", "eligible", "attr_hash", "attr_num",
+            "attr_ver", "class_id", "dev_total", "dev_used", "prio_used",
+            "port_words", "dyn_used",
+        ):
+            host[key][rows] = host[key][src]
+        # Node-unique columns must differ per row: re-hash the synthetic
+        # node ids into the unique-attribute slots.
+        ids = [f"sim-node-{int(r)}" for r in rows]
+        id_hash = np.fromiter(
+            (stable_hash(s) for s in ids), np.int32, len(ids)
+        )
+        for attr in ("node.unique.name", "node.unique.id"):
+            slot = m.attrs.lookup(attr)
+            if slot is not None:
+                host["attr_hash"][rows, slot] = id_hash
+        for r, node_id in zip(rows, ids):
+            m.row_of[node_id] = int(r)
+            m.node_of[int(r)] = node_id
+        m._next_row = N_NODES
 
     # ~N_ALLOCS allocations aggregated per node (the matrix carries usage
     # aggregates, the same thing AllocsFit recomputes per call in the
     # reference, funcs.go:97-150).
-    host = m.snapshot_host()
     per_node = N_ALLOCS / N_NODES
     # Average alloc: ~100 MHz cpu / 128 MB mem / 30 MB disk; cap at 75%.
     usage = rng.poisson(per_node, N_NODES)[:, None] * np.array(
@@ -299,7 +304,11 @@ def build_cluster():
     for j, b in enumerate(rng.choice(PRIORITY_BUCKETS, 4, replace=False)):
         host["prio_used"][:N_NODES, b] = usage * shares[:, j : j + 1]
     m._dirty.update(range(N_NODES))
-    _save_cluster_cache(m)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        m.save_encoded(path)
+    except OSError as e:
+        sys.stderr.write(f"bench: cluster cache write failed ({e})\n")
     return m
 
 
@@ -371,7 +380,11 @@ def bench_kernel(result: dict) -> None:
     import jax
     import jax.numpy as jnp
 
-    from nomad_tpu.ops.kernels import score_batch
+    from nomad_tpu.ops.kernels import (
+        features_of,
+        fused_place_batch,
+        score_batch,
+    )
     from nomad_tpu.parallel import build_batch_inputs
 
     def _mark(msg: str) -> None:
@@ -394,17 +407,28 @@ def bench_kernel(result: dict) -> None:
 
     _mark(f"rtt_floor={result['rtt_floor_ms']}ms; building cluster")
     m = build_cluster()
+    result["cluster_cache"] = CLUSTER_CACHE_STATE
     shapes = build_requests(m)
     arrays = m.sync()
     inp = build_batch_inputs(
         m, [shapes[i % JOB_SHAPES] for i in range(BATCH)]
     )
+    # Occupancy bucketing: compile for the widths the request mix actually
+    # uses (the live coalescer's Features ratchet does the same).
+    feats = features_of(shapes[0])
+    for s in shapes[1:]:
+        feats = feats.widen(features_of(s))
+    result["features"] = {
+        "c_width": feats.c_width, "a_width": feats.a_width,
+        "s_width": feats.s_width, "preempt": feats.preempt,
+        "ports": feats.ports,
+    }
 
     def dispatch():
         return score_batch(
             arrays, arrays.used, inp["tg_counts"], inp["spread_counts"],
             inp["penalties"], inp["reqs"], inp["class_eligs"],
-            inp["host_masks"],
+            inp["host_masks"], features=feats,
         )
 
     # Warmup (compile + cache).
@@ -445,7 +469,7 @@ def bench_kernel(result: dict) -> None:
         return score_batch(
             arrays, arrays.used, inp_i["tg_counts"], inp_i["spread_counts"],
             inp_i["penalties"], inp_i["reqs"], inp_i["class_eligs"],
-            inp_i["host_masks"],
+            inp_i["host_masks"], features=feats,
         )
 
     np.asarray(dispatch_interactive().rows)  # compile for the small shape
@@ -499,6 +523,67 @@ def bench_kernel(result: dict) -> None:
     pipe_total = time.time() - t0
     pipe_rate = n_pipe * BATCH / pipe_total
 
+    # Fused megakernel phase: the WHOLE eval pipeline — feasibility →
+    # binpack → spread/affinity → evict-set → cross-lane AllocsFit
+    # re-verify — in ONE launch for a batch of B evals (vs one launch per
+    # eval on the solo path).  Same pipelined discipline as the headline.
+    _mark("fused megakernel phase")
+    n = int(np.asarray(arrays.used).shape[0])
+    f_dr = jnp.full((BATCH, 1), -1, jnp.int32)
+    f_dv = jnp.zeros((BATCH, 1, 3), jnp.float32)
+    f_lm = jnp.ones((BATCH,), bool)
+
+    def dispatch_fused():
+        return fused_place_batch(
+            arrays, arrays.used, f_dr, f_dv, inp["tg_counts"],
+            inp["spread_counts"], inp["penalties"], inp["reqs"],
+            inp["class_eligs"], inp["host_masks"], f_lm,
+            n_placements=1, features=feats,
+        )
+
+    t_c = time.time()
+    fused_first = np.asarray(dispatch_fused())
+    fused_compile_s = time.time() - t_c
+    fused_placed = int((fused_first[:, :, 0] >= 0).sum())
+    fused_verified = int((fused_first[:, :, -1] > 0.5).sum())
+    t0 = time.time()
+    inflight = []
+    for _ in range(n_pipe):
+        inflight.append(dispatch_fused())
+        if len(inflight) >= PIPELINE_DEPTH:
+            np.asarray(inflight.pop(0))
+    for out in inflight:
+        np.asarray(out)
+    fused_rate = n_pipe * BATCH / (time.time() - t0)
+
+    # Host staging cost per eval on the fused path: encode-slab row fills
+    # plus the per-lane staging-buffer writes the coalescer performs before
+    # a launch — the host work that bounds eval admission into a batch.
+    from nomad_tpu.ops.encode import RequestSlab
+    from nomad_tpu.scheduler.coalescer import MAX_DELTA_ROWS
+
+    slab = RequestSlab(BATCH)
+    stage = {
+        "host_mask": np.ones((BATCH, n), bool),
+        "tg_count": np.zeros((BATCH, n), np.int32),
+        "penalty": np.zeros((BATCH, n), bool),
+        "delta_rows": np.full((BATCH, MAX_DELTA_ROWS), -1, np.int32),
+        "lane_mask": np.zeros((BATCH,), bool),
+    }
+    ones_n = np.ones((n,), bool)
+    zeros_n = np.zeros((n,), np.int32)
+    zeros_b = np.zeros((n,), bool)
+    drow = np.full((MAX_DELTA_ROWS,), -1, np.int32)
+    t0 = time.time()
+    for i in range(BATCH):
+        slab.fill(i, shapes[i % JOB_SHAPES])
+        stage["host_mask"][i] = ones_n
+        stage["tg_count"][i] = zeros_n
+        stage["penalty"][i] = zeros_b
+        stage["delta_rows"][i] = drow
+        stage["lane_mask"][i] = True
+    host_us = (time.time() - t0) / BATCH * 1e6
+
     result.update(
         value=round(pipe_rate, 1),
         vs_baseline=round(pipe_rate / 50000.0, 3),
@@ -519,6 +604,17 @@ def bench_kernel(result: dict) -> None:
         placed_in_first_batch=placed,
         dispatches=DISPATCHES,
         pipeline_depth=PIPELINE_DEPTH,
+        fused_evals_per_sec=round(fused_rate, 1),
+        fused_per_eval_us=round(1e6 / fused_rate, 2),
+        fused_speedup_vs_staged=round(fused_rate / pipe_rate, 3),
+        fused_compile_s=round(fused_compile_s, 1),
+        fused_placed_in_first_batch=fused_placed,
+        fused_verified_in_first_batch=fused_verified,
+        # One fused launch serves BATCH evals; the solo escape-hatch path
+        # is one launch per eval — the ≥10× launches-per-eval claim.
+        fused_launches_per_eval=round(1.0 / BATCH, 6),
+        solo_launches_per_eval=1.0,
+        host_us_per_eval=round(host_us, 2),
     )
 
 
@@ -716,12 +812,21 @@ def bench_host_only(result: dict) -> None:
             )
         wall = time.time() - t0
         completed = HOST_ONLY_JOBS - len(pending)
+        coal = srv.coalescer
         result.update(
             e2e_host_only_evals_per_sec=round(completed / wall, 1),
             e2e_host_only_jobs=HOST_ONLY_JOBS,
             e2e_host_only_nodes=HOST_ONLY_NODES,
             e2e_host_only_workers=HOST_ONLY_WORKERS,
             e2e_host_only_phase_ms=_phase_breakdown(srv.metrics),
+            # Launch accounting through the live coalescer: the fused path
+            # amortizes one launch over every coalesced lane.
+            e2e_host_only_fused_dispatches=coal.fused_dispatches,
+            e2e_host_only_fused_lanes=coal.fused_lanes,
+            e2e_host_only_launches_per_eval=round(
+                coal.fused_dispatches / coal.fused_lanes, 4
+            ) if coal.fused_lanes else None,
+            e2e_host_only_verify_conflicts=coal.verify_conflicts,
         )
     finally:
         if srv is not None:

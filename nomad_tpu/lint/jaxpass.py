@@ -1,4 +1,4 @@
-"""JAX hot-path pass (rules J001–J003).
+"""JAX hot-path pass (rules J001–J004).
 
 The live dispatch path stays fast only while two disciplines hold: no
 implicit device→host sync outside the resolver thread (each one stalls
@@ -23,6 +23,17 @@ This pass enforces both lexically over ``ops/``, ``parallel/``,
   or via a local) to a static parameter, or the jitted function declares
   a mutable default for one: static args key the compile cache by
   hash/eq, so each call raises or recompiles.
+* **J004 per-eval recompile trigger on the fused path** — a call to the
+  mega-batched fused entry points (``fused_place_batch`` /
+  ``fused_place_batch_live``) feeds them a shape-polymorphic operand
+  (``np.stack``/``jnp.asarray`` over a comprehension, or a
+  ``tree_map``-stacked pytree, whose leading dim tracks the batch
+  occupancy) or derives a static arg from the batch (``len(batch)``,
+  ``x.shape[...]``).  Either way the "one compile serves every
+  occupancy" contract breaks and each distinct batch size pays a full
+  XLA compile mid-dispatch.  Preallocate a ``(B, ...)`` operand slab
+  (``ops.encode.RequestSlab``), mask dead lanes with ``lane_mask``, and
+  keep static args bound to configuration constants.
 """
 
 from __future__ import annotations
@@ -48,6 +59,18 @@ DEVICE_PRODUCER_NAMES = {"place_batch_live", "sharded_place_batch"}
 SYNC_CALL_NAMES = {"float", "int", "bool"}
 SYNC_DOTTED = {"np.asarray", "numpy.asarray", "np.array", "numpy.array", "jax.device_get"}
 SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+# J004: the mega-batched fused entry points whose one-compile-per-shape
+# contract the rule protects.
+FUSED_ENTRY_NAMES = {"fused_place_batch", "fused_place_batch_live"}
+# Array constructors that stack per-dispatch Python sequences into a new
+# leading dim — shape-polymorphic when fed a comprehension/starred seq.
+STACKING_CALL_NAMES = {
+    "stack", "vstack", "hstack", "concatenate", "asarray", "array",
+}
+# Static params of the fused entry points (mirrors ops/kernels.py); a
+# batch-derived value here keys a fresh compile per occupancy.
+FUSED_STATIC_PARAMS = ("n_placements", "features")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -79,6 +102,44 @@ def _mutable_display(node: ast.AST) -> bool:
     return isinstance(
         node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
     )
+
+
+def _varlen_stack_call(node: ast.AST) -> bool:
+    """``np.stack([... for ...])`` / ``jnp.asarray(x for ...)`` /
+    ``tree_map(...)``: a call that materializes a per-dispatch Python
+    sequence into a new leading dim, so the result's shape tracks the
+    live batch occupancy instead of a preallocated (B, ...) slab."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    short = (d or "").rsplit(".", 1)[-1]
+    if short == "tree_map":
+        return True
+    if short not in STACKING_CALL_NAMES:
+        return False
+    for a in node.args:
+        if isinstance(a, (ast.ListComp, ast.GeneratorExp)):
+            return True
+        if isinstance(a, (ast.List, ast.Tuple)) and any(
+            isinstance(e, ast.Starred) for e in a.elts
+        ):
+            return True
+    return False
+
+
+def _batch_derived(node: ast.AST) -> bool:
+    """True when the expression reads ``len(...)`` or ``.shape`` — a value
+    that varies with the live batch rather than configuration."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "len"
+        ):
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+    return False
 
 
 class _ModuleInfo:
@@ -158,6 +219,8 @@ def _check_function(
     device_vars: Set[str] = set()
     # locals bound to mutable displays (for J003 via a hop)
     mutable_locals: Dict[str, int] = {}
+    # locals bound to per-dispatch stacked arrays (for J004 via a hop)
+    stacked_locals: Dict[str, int] = {}
 
     statics = _jit_decorator_statics(fn)
     if statics:
@@ -178,6 +241,8 @@ def _check_function(
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             t = node.targets[0]
             if isinstance(t, ast.Name):
+                if _varlen_stack_call(node.value):
+                    stacked_locals[t.id] = node.lineno
                 if _is_device_call(node.value, jitted_names):
                     device_vars.add(t.id)
                 elif _mutable_display(node.value):
@@ -221,6 +286,41 @@ def _check_function(
                 f"fetches through the resolver thread",
             ))
             continue
+
+        # J004: per-eval recompile triggers at fused-megakernel call
+        # sites. The fake-device twin has no compile cache, so its calls
+        # are exempt.
+        short_callee = d.rsplit(".", 1)[-1] if d else None
+        if (
+            short_callee in FUSED_ENTRY_NAMES
+            and not (d or "").startswith("fake_device.")
+        ):
+            for a in node.args:
+                if _varlen_stack_call(a) or (
+                    isinstance(a, ast.Name) and a.id in stacked_locals
+                ):
+                    src = (
+                        a.id if isinstance(a, ast.Name)
+                        else _dotted(a.func) or "<stack call>"
+                    )
+                    findings.append(Finding(
+                        "J004", info.path, node.lineno, symbol,
+                        f"shape-polymorphic operand '{src}' fed to "
+                        f"{short_callee}() — its leading dim tracks the "
+                        f"batch occupancy, so every distinct batch size "
+                        f"recompiles; preallocate a (B, ...) slab "
+                        f"(ops.encode.RequestSlab) and mask dead lanes",
+                    ))
+            for kw in node.keywords:
+                if kw.arg in FUSED_STATIC_PARAMS and _batch_derived(kw.value):
+                    findings.append(Finding(
+                        "J004", info.path, node.lineno, symbol,
+                        f"static arg '{kw.arg}' of {short_callee}() is "
+                        f"derived from the live batch (len()/.shape) — "
+                        f"each occupancy keys a fresh XLA compile; bind "
+                        f"static args to configuration constants and let "
+                        f"lane_mask absorb occupancy",
+                    ))
 
         # J003: mutable value into a static param of a known jitted fn.
         callee = d.rsplit(".", 1)[-1] if d else None

@@ -119,6 +119,61 @@ class SchedRequest(NamedTuple):
     p_dyn: np.ndarray  # () i32
 
 
+class RequestSlab:
+    """Preallocated ``(B, …)`` operand slab for batched request encoding.
+
+    The coalescer's old per-dispatch ``tree_map(np.stack)`` allocated ~25
+    fresh arrays per launch.  The slab instead writes each lane's
+    :class:`SchedRequest` into row ``i`` of persistent ``(B, …)`` buffers
+    and hands the SAME request-of-buffers pytree to the kernel every
+    dispatch — no per-launch allocation, stable shapes for the jit cache.
+
+    Rows past the live count keep their previous (valid) contents — dead
+    lanes are masked by ``lane_mask``/``host_mask``, never decoded into
+    results — and the whole slab is broadcast-initialized from the first
+    request filled so even a cold slab holds well-formed rows.  Buffers are
+    rebuilt only if a field's trailing shape shifts (encoder version
+    change)."""
+
+    def __init__(self, lanes: int):
+        self.lanes = int(lanes)
+        self._bufs: Optional[SchedRequest] = None
+
+    def _build(self, proto: SchedRequest) -> SchedRequest:
+        fields = [np.asarray(f) for f in proto]
+        bufs = SchedRequest(*[
+            np.empty((self.lanes,) + f.shape, f.dtype) for f in fields
+        ])
+        for buf, f in zip(bufs, fields):
+            buf[:] = f  # broadcast: every row starts as a valid request
+        return bufs
+
+    def fill(self, i: int, req: SchedRequest) -> None:
+        """Write ``req`` into lane row ``i`` (rebuilds on shape drift)."""
+        bufs = self._bufs
+        if bufs is None or any(
+            buf.shape[1:] != np.asarray(f).shape
+            for buf, f in zip(bufs, req)
+        ):
+            bufs = self._bufs = self._build(req)
+        for buf, f in zip(bufs, req):
+            buf[i] = f
+
+    def batch(self) -> SchedRequest:
+        """The full (B, …) stacked request pytree (call after fill)."""
+        assert self._bufs is not None, "fill at least one lane first"
+        return self._bufs
+
+    def live_view(self, k: int) -> SchedRequest:
+        """Zero-copy views of the first ``k`` (live) rows — what occupancy
+        measurement (kernels.features_of) should see, not stale tails."""
+        assert self._bufs is not None, "fill at least one lane first"
+        return SchedRequest(*[buf[:k] for buf in self._bufs])
+
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self._bufs) if self._bufs else 0
+
+
 @dataclass
 class EscapedConstraint:
     """A constraint the kernel can't evaluate; checked host-side per class

@@ -214,9 +214,10 @@ def fit_and_binpack(arrays, used, req: SchedRequest):
 
     denom = np.maximum(arrays.totals, np.float32(1.0))
     free = np.float32(1.0) - util / denom
-    total = np.power(np.float32(10.0), free[:, 0]) + np.power(
-        np.float32(10.0), free[:, 1]
-    )
+    # exp2(x·log₂10) mirrors the kernel's 10**x lowering exactly (see
+    # kernels.fit_and_binpack).
+    log2_10 = np.float32(3.321928094887362)
+    total = np.exp2(free[:, 0] * log2_10) + np.exp2(free[:, 1] * log2_10)
     binpack = np.clip(np.float32(20.0) - total, 0.0, 18.0)
     spread = np.clip(total - np.float32(2.0), 0.0, 18.0)
     score = np.where(int(req.algorithm) == 1, spread, binpack) / np.float32(18.0)
@@ -708,6 +709,77 @@ def place_batch(arrays, used, delta_rows: List[np.ndarray],
         )
         if steps < n_placements:
             out[i, steps:, 0] = -1.0
+    return out
+
+
+# Packed-output constants of the fused megakernel, mirrored from
+# ops/kernels.py (this module stays importable without JAX).
+FUSED_PACKED_VERIFIED = 7
+FUSED_PACKED_WIDTH = 8
+
+
+def fused_place_batch(arrays, used, delta_rows: List[np.ndarray],
+                      delta_vals: List[np.ndarray],
+                      tg_counts: List[np.ndarray],
+                      spread_counts: List[np.ndarray],
+                      penalties: List[np.ndarray],
+                      reqs: List[SchedRequest],
+                      class_eligs: List[np.ndarray],
+                      host_masks: List[np.ndarray],
+                      lane_mask,
+                      n_placements: int,
+                      live_counts: Optional[List[int]] = None) -> np.ndarray:
+    """Twin of kernels.fused_place_batch — (B, P, FUSED_PACKED_WIDTH) f32.
+
+    Adds the sequential cross-lane AllocsFit VERIFIED column on top of the
+    staged scans: lanes commit their in-flight deltas and placements to a
+    cumulative usage image in lane order, and each placement is checked
+    against it (1.0 fits, 0.0 an earlier lane claimed the capacity, -1.0
+    dead lane). ``lane_mask`` marks live lanes explicitly; dead lanes emit
+    row=-1 / zeros and touch nothing.
+
+    With ``live_counts`` the uncomputed tail rows are shape-filler
+    (row=-1, verified=1.0) exactly like :func:`place_batch`; kernel-exact
+    parity requires live_counts=None.
+    """
+    b = len(reqs)
+    lane_mask = np.asarray(lane_mask, bool)
+    out = np.zeros((b, n_placements, FUSED_PACKED_WIDTH), np.float32)
+    cum_used = np.array(used, np.float32, copy=True)
+    for i in range(b):
+        if not lane_mask[i]:
+            out[i, :, 0] = -1.0
+            out[i, :, FUSED_PACKED_VERIFIED] = -1.0
+            continue
+        drows = np.asarray(delta_rows[i])
+        dvals = np.asarray(delta_vals[i])
+        live = drows >= 0
+        used0 = used
+        if live.any():
+            used0 = used.copy()
+            np.add.at(used0, drows[live], dvals[live])
+        steps = n_placements
+        if live_counts is not None:
+            steps = max(1, min(n_placements, int(live_counts[i])))
+        out[i, :steps, :7] = _place_scan(
+            arrays, reqs[i], used0, tg_counts[i], spread_counts[i],
+            penalties[i], class_eligs[i], host_masks[i], steps,
+        )
+        if steps < n_placements:
+            out[i, steps:, 0] = -1.0
+        # Sequential AllocsFit re-verify against the cumulative image.
+        if live.any():
+            np.add.at(cum_used, drows[live], dvals[live])
+        ask = np.asarray(reqs[i].ask, np.float32)
+        for p in range(n_placements):
+            row = int(out[i, p, 0])
+            if row < 0:
+                out[i, p, FUSED_PACKED_VERIFIED] = 1.0
+                continue
+            cum_used[row] += ask
+            out[i, p, FUSED_PACKED_VERIFIED] = float(
+                np.all(cum_used[row] <= arrays.totals[row])
+            )
     return out
 
 

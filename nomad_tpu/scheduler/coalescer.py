@@ -60,7 +60,7 @@ import numpy as np
 
 from .. import trace
 from ..ops import kernels
-from ..ops.encode import SchedRequest
+from ..ops.encode import RequestSlab, SchedRequest
 from ..retry import env_int
 from ..state.matrix import DEVICE_LOCK
 
@@ -71,12 +71,23 @@ log = logging.getLogger(__name__)
 MAX_DELTA_ROWS = 32
 
 _DEPTH_ENV = "NOMAD_TPU_PIPELINE_DEPTH"
+_MEGABATCH_ENV = "NOMAD_TPU_MEGABATCH"
 
 
 def default_pipeline_depth() -> int:
     """Overlapping dispatches kept in flight (env-tunable, default 8 — the
     depth bench.py's pipelined phase showed amortizing the tunnel RTT)."""
     return max(1, env_int(_DEPTH_ENV, 8))
+
+
+def megabatch_enabled() -> bool:
+    """The fused megakernel path (ops.kernels.fused_place_batch): explicit
+    lane masks, occupancy-bucketed compiles, and the device-resident
+    AllocsFit re-verify column. Default ON; ``NOMAD_TPU_MEGABATCH=0``
+    falls back to the staged place_batch path."""
+    return os.environ.get(_MEGABATCH_ENV, "1").lower() not in (
+        "0", "off", "false",
+    )
 
 
 @dataclass
@@ -90,6 +101,14 @@ class PlaceOutcome:
     nodes_evaluated: np.ndarray  # (P,) i32
     nodes_filtered: np.ndarray  # (P,) i32
     nodes_exhausted: np.ndarray  # (P,) i32
+    # Fused-path extras: device-resident AllocsFit re-verify verdicts
+    # ((P,) bool — True = placement survives the sequential cross-lane
+    # re-check at `matrix_version`; None on the staged path) and the matrix
+    # version the dispatch was scored against. At an unchanged version a
+    # False verdict is a guaranteed plan-applier rejection; the applier
+    # against live state stays authoritative either way.
+    fit_verified: Optional[np.ndarray] = None
+    matrix_version: int = -1
 
 
 @dataclass
@@ -186,6 +205,10 @@ class DeviceCoalescer:
         # into — per-dispatch np.stack allocations replaced by row writes,
         # lane padding by memset (see _staging).
         self._stage: Optional[Dict[str, np.ndarray]] = None
+        # Preallocated (max_lanes, …) request operand slab: per-lane
+        # SchedRequest pytrees write rows in place instead of the old
+        # per-dispatch tree_map(np.stack) allocation storm.
+        self._req_slab = RequestSlab(max_lanes)
         # Gauges/counters (ints under the GIL; exact enough for telemetry).
         self.dispatches = 0
         self.coalesced_requests = 0
@@ -196,6 +219,20 @@ class DeviceCoalescer:
         # traffic staged per batched dispatch.
         self.solo_ops = 0
         self.operand_bytes_total = 0
+        # Fused-megakernel accounting: launches and live lanes through the
+        # fused path (launches-per-eval = fused_dispatches / fused_lanes),
+        # verify-column conflicts (placements an earlier lane's plan will
+        # make the applier reject), and the occupancy-features ratchet —
+        # a monotone widening union, so each Features variant compiles at
+        # most once per process instead of flapping per batch.
+        self.megabatch = megabatch_enabled()
+        if self.megabatch:
+            kernels.pallas_requested()  # warn once if the reserved flag is set
+        self.fused_dispatches = 0
+        self.fused_lanes = 0
+        self.verify_conflicts = 0
+        self.feature_recompiles = 0
+        self._features = None
         # TSan-lite (lint/tsan.py): lockset checking on the pending queue
         # and device-op list when a test enabled the sanitizer.
         from ..lint.tsan import maybe_instrument
@@ -483,6 +520,7 @@ class DeviceCoalescer:
                 "delta_vals": np.zeros(
                     (lanes, MAX_DELTA_ROWS, 3), np.float32
                 ),
+                "lane_mask": np.zeros((lanes,), bool),
             }
         return st
 
@@ -536,9 +574,7 @@ class DeviceCoalescer:
                         p.penalty,
                         np.zeros((n - p.penalty.shape[0],), bool),
                     ])
-            packed = fake_device.place_batch(
-                arrays,
-                arrays.used,
+            lane_lists = (
                 [p.delta_rows for p in batch],
                 [p.delta_vals for p in batch],
                 [p.tg_count for p in batch],
@@ -547,9 +583,30 @@ class DeviceCoalescer:
                 [p.request for p in batch],
                 [p.class_elig for p in batch],
                 [p.host_mask for p in batch],
-                n_placements=self.scan_length,
-                live_counts=[p.n_live or self.scan_length for p in batch],
             )
+            if self.megabatch:
+                packed = fake_device.fused_place_batch(
+                    arrays,
+                    arrays.used,
+                    *lane_lists,
+                    lane_mask=np.ones((len(batch),), bool),
+                    n_placements=self.scan_length,
+                    live_counts=[
+                        p.n_live or self.scan_length for p in batch
+                    ],
+                )
+                self.fused_dispatches += 1
+                self.fused_lanes += len(batch)
+            else:
+                packed = fake_device.place_batch(
+                    arrays,
+                    arrays.used,
+                    *lane_lists,
+                    n_placements=self.scan_length,
+                    live_counts=[
+                        p.n_live or self.scan_length for p in batch
+                    ],
+                )
             self.operand_bytes_total += sum(
                 p.host_mask.nbytes + p.tg_count.nbytes + p.penalty.nbytes
                 + p.class_elig.nbytes + p.spread_counts.nbytes
@@ -563,8 +620,6 @@ class DeviceCoalescer:
                 packed = fake_device.DeferredResult(packed, lat)
             return packed, version
 
-        import jax
-
         k = len(batch)
         cw = max(p.class_elig.shape[0] for p in batch)
         sc_shape = batch[0].spread_counts.shape
@@ -572,6 +627,9 @@ class DeviceCoalescer:
         hm, tg = st["host_mask"], st["tg_count"]
         pen, ce = st["penalty"], st["class_elig"]
         sc, dr, dv = st["spread_counts"], st["delta_rows"], st["delta_vals"]
+        lm = st["lane_mask"]
+        lm[:k] = True
+        lm[k:] = False
         for i, p in enumerate(batch):
             # Requests built just before a matrix growth or a class-count
             # pow2 crossing carry narrower arrays; the staging row's tail
@@ -602,25 +660,46 @@ class DeviceCoalescer:
             hm[k:] = False
             dr[k:] = -1
 
-        # Request pytrees still stack per dispatch (small per-predicate
-        # arrays); dead lanes reuse lane 0's request.
-        req_lanes = [p.request for p in batch]
-        if k < self.max_lanes:
-            req_lanes.extend([batch[0].request] * (self.max_lanes - k))
-        reqs = jax.tree_util.tree_map(
-            lambda *xs: np.stack(xs), *req_lanes
-        )
+        # Request operands write into the preallocated (max_lanes, …) slab;
+        # dead-lane rows keep their previous valid contents (masked off by
+        # lane_mask / the all-False host mask, never decoded into results).
+        for i, p in enumerate(batch):
+            self._req_slab.fill(i, p.request)
+        reqs = self._req_slab.batch()
         # Host→device operand traffic for this launch: the staged lane
-        # buffers plus the stacked request pytree (cost-attribution gauge;
-        # the resident matrix itself transfers via scatter, counted by
+        # buffers plus the request slab (cost-attribution gauge; the
+        # resident matrix itself transfers via scatter, counted by
         # matrix.upload_bytes_total).
-        self.operand_bytes_total += sum(a.nbytes for a in st.values()) + sum(
-            x.nbytes for x in jax.tree_util.tree_leaves(reqs)
-            if hasattr(x, "nbytes")
+        self.operand_bytes_total += (
+            sum(a.nbytes for a in st.values()) + self._req_slab.nbytes()
         )
         if n_shards > 1:
+            # The sharded SPMD twin stays on the staged path: its packed
+            # result is PACKED_WIDTH wide and _resolve distinguishes the
+            # two by the trailing dimension.
             return self._sharded_fn(
                 sharded, sharded.used, dr, dv, tg, sc, pen, reqs, ce, hm
+            ), version
+        if self.megabatch:
+            # Fused megakernel: one launch covers feasibility → binpack →
+            # spread/affinity → evict-set → the cross-lane AllocsFit
+            # re-verify column.  The Features ratchet widens monotonically
+            # so occupancy-bucketed variants compile at most once each —
+            # a narrow batch after a wide one reuses the wide executable.
+            feats = kernels.features_of(self._req_slab.live_view(k))
+            widened = (
+                feats if self._features is None
+                else self._features.widen(feats)
+            )
+            if widened != self._features:
+                self.feature_recompiles += 1
+                self._features = widened
+            self.fused_dispatches += 1
+            self.fused_lanes += k
+            return kernels.fused_place_batch_live(
+                arrays, arrays.used, dr, dv, tg, sc, pen, reqs, ce, hm,
+                lm, n_placements=self.scan_length,
+                features=self._features,
             ), version
         # place_batch_live donates the per-dispatch lane operands (their
         # device buffers become XLA scratch); `arrays`/`used` stay live —
@@ -666,8 +745,20 @@ class DeviceCoalescer:
             # gauge over this attribute by the server).
             self.stale_dispatches += 1
             trace.event("coalescer.stale_dispatch")
+        fused = arr.shape[-1] == kernels.FUSED_PACKED_WIDTH
         for i, p in enumerate(entries):
             row = arr[i]
+            fit_verified = None
+            if fused:
+                # The device-resident AllocsFit column: a 0.0 on a real
+                # placement means an earlier lane in THIS launch already
+                # claimed the capacity — at an unchanged matrix version the
+                # applier is guaranteed to reject it.  Advisory: the
+                # serialized applier stays authoritative either way.
+                vcol = row[:, kernels.FUSED_PACKED_VERIFIED]
+                placed = row[:, kernels.PACKED_ROW] >= 0
+                fit_verified = ~(placed & (vcol == 0.0))
+                self.verify_conflicts += int((~fit_verified).sum())
             p.outcome = PlaceOutcome(
                 rows=row[:, kernels.PACKED_ROW].astype(np.int32),
                 scores=row[:, kernels.PACKED_SCORE],
@@ -682,5 +773,7 @@ class DeviceCoalescer:
                 nodes_exhausted=row[:, kernels.PACKED_EXHAUSTED].astype(
                     np.int32
                 ),
+                fit_verified=fit_verified,
+                matrix_version=ticket.matrix_version,
             )
             p.done.set()

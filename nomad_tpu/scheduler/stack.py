@@ -54,6 +54,21 @@ PLACEMENT_CHUNK = 16
 # conflict) or preemption-assisted picks.
 MAX_SELECT_RETRIES = 8
 
+# Solo-path occupancy ratchet (mirrors DeviceCoalescer._features): the
+# Features bucket widens monotonically across the process, so the jit cache
+# sees a short chain of variants instead of flapping per request.  Mutated
+# only on the device thread (dev_op closures run serialized).
+_solo_features: Optional[kernels.Features] = None
+
+
+def _ratchet_features(request) -> kernels.Features:
+    global _solo_features
+    feats = kernels.features_of(request)
+    _solo_features = (
+        feats if _solo_features is None else _solo_features.widen(feats)
+    )
+    return _solo_features
+
 
 def _dense_used0(arrays, deltas: Dict[int, np.ndarray]):
     """Proposed base usage: matrix usage + sparse per-row plan deltas.
@@ -97,6 +112,11 @@ class SelectionOption:
     metric: AllocMetric = field(default_factory=AllocMetric)
     # task -> {label: port} assigned host-side for the chosen node
     assigned_ports: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # Fused-path advisory: the device's sequential cross-lane AllocsFit
+    # verdict for this placement (False = an earlier lane in the same
+    # launch claimed the capacity — the applier will reject this plan at an
+    # unchanged matrix version).  None on the staged/solo paths.
+    fit_verified: Optional[bool] = None
 
 
 
@@ -503,9 +523,10 @@ class GenericStack:
         remaining: int,
     ):
         """Run one placement scan; returns host-side arrays (rows, scores,
-        binpack, preempted, n_eval, n_filt, n_exh) of scan length ≥ the
-        bucket for ``remaining``."""
-        from .coalescer import MAX_DELTA_ROWS
+        binpack, preempted, n_eval, n_filt, n_exh, fit_verified) of scan
+        length ≥ the bucket for ``remaining``.  fit_verified is None unless
+        the fused megakernel path supplied its cross-lane verify column."""
+        from .coalescer import MAX_DELTA_ROWS, megabatch_enabled
 
         # One consistent width for every per-node array in this request:
         # re-reading matrix.capacity here could disagree with the shapes the
@@ -533,6 +554,7 @@ class GenericStack:
             return (
                 out.rows, out.scores, out.binpack, out.preempted,
                 out.nodes_evaluated, out.nodes_filtered, out.nodes_exhausted,
+                out.fit_verified,
             )
 
         # Solo path: dense proposed usage, one direct dispatch.  With a
@@ -558,10 +580,15 @@ class GenericStack:
                     result.rows, result.scores, result.binpack,
                     result.preempted, result.nodes_evaluated,
                     result.nodes_filtered, result.nodes_exhausted,
+                    None,
                 )
 
             import jax.numpy as jnp
 
+            feats = (
+                _ratchet_features(compiled.request)
+                if megabatch_enabled() else kernels.FULL_FEATURES
+            )
             result = kernels.place_task_group(
                 arrays,
                 compiled.request,
@@ -572,6 +599,7 @@ class GenericStack:
                 jnp.asarray(class_elig),
                 jnp.asarray(_pad_width(_full_mask(n, host_mask), n_dev, False)),
                 n_placements=bucket,
+                features=feats,
             )
             return (
                 np.asarray(result.rows),
@@ -581,6 +609,7 @@ class GenericStack:
                 np.asarray(result.nodes_evaluated),
                 np.asarray(result.nodes_filtered),
                 np.asarray(result.nodes_exhausted),
+                None,
             )
 
         return self.matrix.run_on_device(dev_op)
@@ -673,7 +702,7 @@ class GenericStack:
             # span covers the whole device dispatch (launch + result wait).
             with trace.span("sched.dispatch", lanes=remaining):
                 (rows_all, scores_all, binpack_all, preempted_all, n_eval_all,
-                 n_filt_all, n_exh_all) = self._dispatch_place(
+                 n_filt_all, n_exh_all, verified_all) = self._dispatch_place(
                     compiled, deltas, tg_count, spread_counts, penalty,
                     class_elig, host_mask, remaining,
                 )
@@ -728,6 +757,10 @@ class GenericStack:
                     needs_preempt=bool(preempted[i]),
                     metric=metric,
                     assigned_ports=ports,
+                    fit_verified=(
+                        bool(verified_all[i])
+                        if verified_all is not None else None
+                    ),
                 )
                 options.append(opt)
                 chosen_rows.append(int(row))

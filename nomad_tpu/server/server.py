@@ -249,6 +249,25 @@ class Server:
         # compile-cache hit/miss, and host→device operand traffic.
         m.gauge_fn("nomad.kernel.launches", lambda: c.dispatches, path="batched")
         m.gauge_fn("nomad.kernel.launches", lambda: c.solo_ops, path="solo")
+        # Fused megakernel accounting: one launch serves every coalesced
+        # lane (launches/eval = fused_dispatches / fused_lanes), plus the
+        # cross-lane AllocsFit verify verdicts and the occupancy-features
+        # recompile ratchet.
+        m.gauge_fn(
+            "nomad.kernel.launches", lambda: c.fused_dispatches, path="fused"
+        )
+        m.gauge_fn("nomad.kernel.fused_lanes", lambda: c.fused_lanes)
+        m.gauge_fn(
+            "nomad.kernel.launches_per_eval",
+            lambda: round(c.fused_dispatches / (c.fused_lanes or 1), 4),
+            path="fused",
+        )
+        m.gauge_fn(
+            "nomad.kernel.verify_conflicts", lambda: c.verify_conflicts
+        )
+        m.gauge_fn(
+            "nomad.kernel.feature_recompiles", lambda: c.feature_recompiles
+        )
         m.gauge_fn(
             "nomad.kernel.compile_cache", lambda: enc.cache_hits, result="hit"
         )

@@ -35,6 +35,7 @@ host↔device transfer per plan).
 from __future__ import annotations
 
 import math
+import os
 import threading
 import zlib
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -630,6 +631,90 @@ class NodeMatrix:
     def snapshot_host(self) -> Dict[str, np.ndarray]:
         """Host-side view (no copy) of the active arrays."""
         return self._alloc
+
+    # -- encoded-matrix persistence (bench warm-start) ----------------------
+
+    # Bump when the encoded layout changes (array fields, registry
+    # semantics, hashing): stale caches must miss, not deserialize wrong.
+    ENCODED_FORMAT = 2
+
+    def save_encoded(self, path) -> None:
+        """Serialize the fully encoded host matrix — arrays, row maps, and
+        registries — to ``path`` (.npz).  The bench warm path reloads this
+        instead of re-walking Node objects through upsert_node (the ~100 s
+        serial cold-start the cache exists to skip)."""
+        import json
+
+        with self._host_lock:
+            meta = {
+                "format": self.ENCODED_FORMAT,
+                "capacity": self.capacity,
+                "next_row": self._next_row,
+                "free": list(self._free),
+                "row_of": self.row_of,
+                "class_ids": self.class_ids,
+                "class_repr": self.class_repr,
+                "attr_slots": self.attrs.slots,
+                "attr_slot_of": self.attrs.slot_of,
+                "dev_slots": self.devices.slots,
+                "dev_slot_of": self.devices.slot_of,
+            }
+            payload = dict(self._alloc)
+            payload["__meta__"] = np.frombuffer(
+                json.dumps(meta).encode(), np.uint8
+            )
+            tmp = str(path) + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.savez(fh, **payload)
+            os.replace(tmp, str(path))
+
+    def load_encoded(self, path) -> bool:
+        """Restore a matrix serialized by :meth:`save_encoded`.  Returns
+        False (leaving the matrix untouched) on any format/shape mismatch —
+        callers fall back to the cold build path."""
+        import json
+
+        try:
+            with np.load(str(path)) as data:
+                meta = json.loads(bytes(data["__meta__"]).decode())
+                if meta.get("format") != self.ENCODED_FORMAT:
+                    return False
+                arrays = {
+                    k: data[k] for k in self._alloc if k in data.files
+                }
+        except (OSError, ValueError, KeyError):
+            return False
+        if set(arrays) != set(self._alloc):
+            return False
+        with self._host_lock:
+            self.capacity = int(meta["capacity"])
+            self._next_row = int(meta["next_row"])
+            self._free = [int(r) for r in meta["free"]]
+            self.row_of = {k: int(v) for k, v in meta["row_of"].items()}
+            self.node_of = {v: k for k, v in self.row_of.items()}
+            self.class_ids = {
+                k: int(v) for k, v in meta["class_ids"].items()
+            }
+            self.class_repr = {
+                int(k): v for k, v in meta["class_repr"].items()
+            }
+            self.attrs.slots = int(meta["attr_slots"])
+            self.attrs.slot_of = {
+                k: int(v) for k, v in meta["attr_slot_of"].items()
+            }
+            self.devices.slots = int(meta["dev_slots"])
+            self.devices.slot_of = {
+                k: int(v) for k, v in meta["dev_slot_of"].items()
+            }
+            self._alloc = {k: np.array(v) for k, v in arrays.items()}
+            self._dirty.clear()
+            self._sharded_dirty.clear()
+            self._device_valid = False
+            self._sharded_valid = False
+            self._shared_masks = None
+            self._shared_zero_i32 = None
+            self.version += 1
+        return True
 
     def sync(self) -> DeviceArrays:
         """Return the device snapshot, scattering dirty rows if needed.

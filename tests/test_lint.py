@@ -341,6 +341,94 @@ class TestJaxPass:
 
 
 # ----------------------------------------------------------------------
+# J004 — fused-path recompile triggers
+# ----------------------------------------------------------------------
+
+class TestJ004FusedRecompile:
+    def test_stacked_comprehension_operand_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def bad(self, arrays, batch):
+                    return kernels.fused_place_batch(
+                        arrays, arrays.used,
+                        np.stack([p.delta_rows for p in batch]),
+                        self.lane_mask, n_placements=4,
+                    )
+                """
+            )
+        })
+        assert "J004" in _rules(fs), fs
+
+    def test_tree_map_stacked_requests_fire(self):
+        # The exact anti-pattern the RequestSlab replaced: restacking the
+        # request pytree per dispatch.
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def bad(self, arrays, batch, lm):
+                    reqs = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs),
+                        *[p.request for p in batch],
+                    )
+                    return kernels.fused_place_batch_live(
+                        arrays, arrays.used, reqs, lm, n_placements=4,
+                    )
+                """
+            )
+        })
+        assert "J004" in _rules(fs), fs
+
+    def test_batch_derived_static_arg_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def bad(self, arrays, batch, reqs, lm):
+                    return kernels.fused_place_batch(
+                        arrays, arrays.used, reqs, lm,
+                        n_placements=len(batch),
+                    )
+                """
+            )
+        })
+        assert "J004" in _rules(fs), fs
+
+    def test_slab_operands_and_config_statics_are_clean(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def good(self, arrays, lm):
+                    reqs = self._req_slab.batch()
+                    return kernels.fused_place_batch_live(
+                        arrays, arrays.used, reqs, lm,
+                        n_placements=self.scan_length,
+                        features=self._features,
+                    )
+                """
+            )
+        })
+        assert "J004" not in _rules(fs), fs
+
+    def test_fake_device_twin_is_exempt(self):
+        # The numpy twin takes per-lane lists by design — no compile
+        # cache to poison.
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def good(self, arrays, batch):
+                    return fake_device.fused_place_batch(
+                        arrays, arrays.used,
+                        np.stack([p.delta_rows for p in batch]),
+                        n_placements=4,
+                        live_counts=[p.n_live for p in batch],
+                    )
+                """
+            )
+        })
+        assert "J004" not in _rules(fs), fs
+
+
+# ----------------------------------------------------------------------
 # C001–C004 — chaos seams
 # ----------------------------------------------------------------------
 

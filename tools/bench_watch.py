@@ -1,4 +1,4 @@
-"""Probe-then-bench retry loop: land the TPU evidence artifact.
+"""Standing probe-then-bench watch: land the TPU evidence artifact.
 
 The TPU tunnel wedges for long stretches (VERDICT rounds 2/4/5): a bench
 started while it is wedged burns its whole probe budget and falls back to
@@ -9,14 +9,28 @@ run.  On the first bench that reports ``platform != cpu`` the raw JSON is
 written to ``BENCH_tpu_evidence.json`` at the repo root — the artifact
 PARITY.md's ≥50K claim is waiting on.
 
-The bench it launches runs every phase of ``bench.py`` main(), which
-since round 6 includes the ``live_pipeline`` depth sweep (pipelined
-coalescer under synthetic fetch latency, ``BENCH_LIVE_*`` knobs) — a
-TPU evidence artifact therefore also carries the live-path pipelining
-numbers alongside the kernel throughput.
+This is a STANDING watch, not a fixed-cadence poll:
+
+* failed probes back off exponentially through the shared
+  ``nomad_tpu.retry`` policy (base ``--interval``, capped at
+  ``--max-interval``, jittered) — probing a wedged tunnel faster does not
+  unwedge it, and an overnight watch shouldn't hammer the rig;
+* EVERY wedged or failed probe (and every bench that died or fell back
+  after a healthy probe) is recorded to ``BENCH_LEDGER.jsonl`` as a
+  failed-run entry at the moment it happens — "the tunnel was dead from
+  02:10 to 05:40" is readable from the ledger afterwards, not just a
+  terminal tally;
+* ``--max-hours`` bounds the whole watch in wall-clock time regardless of
+  how many attempts the backoff schedule would still allow.
+
+The bench it launches runs every phase of ``bench.py`` main(), including
+the fused-megakernel phase (one launch per batched eval pipeline) — a TPU
+evidence artifact therefore carries the fused and staged numbers side by
+side.
 
 Usage:
-    python tools/bench_watch.py [--attempts N] [--interval S] [--once]
+    python tools/bench_watch.py [--attempts N] [--interval S]
+                                [--max-interval S] [--max-hours H] [--once]
 
 Exit codes: 0 = evidence written (or already present), 1 = budget
 exhausted without a TPU bench, 2 = bad invocation.
@@ -49,9 +63,10 @@ PROBE_TIMEOUT = env_int("BENCH_PROBE_TIMEOUT", 150)
 # dies between probe and pipelined phase).
 BENCH_TIMEOUT = env_int("BENCH_WATCH_BENCH_TIMEOUT", 1800)
 
-# Probes/benches that had to be SIGKILLed (wedged tunnel analog).  The
-# count rides into the ledger entry (``probe_wedged``) so wedge frequency
-# is trendable next to the numbers it delayed.
+# Probes/benches that had to be SIGKILLed (wedged tunnel analog).  Each is
+# ALSO recorded to the ledger as it happens (_record_failure); the tally
+# additionally rides into the final evidence entry so wedge frequency is
+# trendable next to the numbers it delayed.
 WEDGED = {"probe": 0, "bench": 0}
 
 
@@ -103,8 +118,9 @@ def run_bench() -> dict | None:
     # The probe already succeeded — skip the bench's own 4-attempt probe
     # ladder so a mid-run wedge fails fast into THIS loop's next attempt.
     env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
-    # The watcher records the ledger entry itself (with the wedge counts
-    # merged in) — the child recording too would double-count the run.
+    # The watcher records the ledger entries itself (per-failure records +
+    # the final evidence entry) — the child recording too would
+    # double-count the run.
     env["NOMAD_TPU_BENCH_LEDGER"] = "off"
     rc, out, err = _run_reaped(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -131,19 +147,54 @@ def run_bench() -> dict | None:
     return None
 
 
-def _record_ledger(result: dict) -> None:
-    """One ledger entry for this watch (child bench recording is off),
-    with the SIGKILL tallies merged in as ``probe_wedged`` counts."""
-    result = dict(result)
-    result["probe_wedged"] = WEDGED["probe"]
-    result["bench_wedged"] = WEDGED["bench"]
+def _ledger_kwargs() -> dict | None:
+    """Ledger destination from the env; None = recording disabled."""
     ledger_env = os.environ.get("NOMAD_TPU_BENCH_LEDGER", "")
     if ledger_env.lower() in ("0", "off", "no"):
+        return None
+    return {"ledger": ledger_env} if ledger_env else {}
+
+
+def _record_failure(attempt: int, reason: str) -> None:
+    """One failed-run ledger entry PER wedged/failed probe or bench, at
+    the moment it happens — the driver-wrapper input shape (rc/parsed/
+    tail) normalizes to ``ok: false``, so failures are visible in the
+    history without ever contributing to a metric baseline."""
+    kw = _ledger_kwargs()
+    if kw is None:
         return
     try:
         import bench_history
 
-        kw = {"ledger": ledger_env} if ledger_env else {}
+        bench_history.record_run(
+            {
+                "n": attempt,
+                "cmd": "bench_watch probe",
+                "rc": 1,
+                "parsed": None,
+                "tail": reason,
+            },
+            source="bench_watch.py",
+            **kw,
+        )
+    except Exception as e:  # noqa: BLE001 — the ledger must never cost a run
+        sys.stderr.write(
+            f"bench_watch ledger skipped: {type(e).__name__}: {e}\n"
+        )
+
+
+def _record_ledger(result: dict) -> None:
+    """The successful-run ledger entry, with the SIGKILL tallies merged in
+    as ``probe_wedged``/``bench_wedged`` counts."""
+    kw = _ledger_kwargs()
+    if kw is None:
+        return
+    result = dict(result)
+    result["probe_wedged"] = WEDGED["probe"]
+    result["bench_wedged"] = WEDGED["bench"]
+    try:
+        import bench_history
+
         entry = bench_history.record_run(
             result, source="bench_watch.py", **kw
         )
@@ -157,13 +208,21 @@ def _record_ledger(result: dict) -> None:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--attempts", type=int, default=12,
-                    help="max probe attempts (default 12)")
-    ap.add_argument("--interval", type=float, default=300.0,
-                    help="seconds between failed probes (default 300)")
+    ap.add_argument("--attempts", type=int, default=48,
+                    help="max probe attempts (default 48)")
+    ap.add_argument("--interval", type=float, default=60.0,
+                    help="base seconds between failed probes (default 60; "
+                         "backs off exponentially from here)")
+    ap.add_argument("--max-interval", type=float, default=900.0,
+                    help="backoff ceiling in seconds (default 900)")
+    ap.add_argument("--max-hours", type=float, default=12.0,
+                    help="hard wall-clock bound on the whole watch "
+                         "(default 12h)")
     ap.add_argument("--once", action="store_true",
                     help="single probe+bench attempt, no retry loop")
     args = ap.parse_args()
+    if args.interval <= 0 or args.max_hours <= 0 or args.attempts <= 0:
+        ap.error("--interval/--max-hours/--attempts must be positive")
 
     if os.path.exists(EVIDENCE):
         sys.stderr.write(f"bench_watch: {EVIDENCE} already present\n")
@@ -182,6 +241,7 @@ def main() -> int:
             f"bench_watch: probe {seen['n']}/{attempts}: {plat}\n"
         )
         if not plat or plat.startswith("err:") or plat == "cpu":
+            _record_failure(seen["n"], f"probe: {plat}")
             raise _NoEvidence(f"probe: {plat}")
         result = run_bench()
         if result is None or result.get("platform") == "cpu":
@@ -189,27 +249,42 @@ def main() -> int:
                 "bench_watch: probe was healthy but the bench run "
                 "fell back / died; retrying\n"
             )
+            _record_failure(
+                seen["n"],
+                "bench fell back / died after healthy probe "
+                f"(platform={None if result is None else result.get('platform')})",
+            )
             raise _NoEvidence("bench fell back / died")
         return result
 
-    # Flat (multiplier=1, no jitter) schedule: probing a wedged tunnel
-    # faster doesn't unwedge it, and the operator asked for --interval.
+    # Exponential backoff (shared retry.py policy): a wedged tunnel isn't
+    # unwedged by probing harder, so the schedule stretches from
+    # --interval toward --max-interval, jittered to decorrelate from any
+    # rig-side periodicity.  --max-hours is the deadline backstop — the
+    # watch ends on whichever budget (attempts or wall clock) runs out
+    # first.
     policy = RetryPolicy(
-        base_delay=args.interval, multiplier=1.0, jitter=0.0,
+        base_delay=args.interval,
+        max_delay=max(args.interval, args.max_interval),
+        multiplier=2.0,
+        jitter=0.25,
         max_attempts=attempts,
+        deadline=args.max_hours * 3600.0,
     )
+
+    def on_retry(attempt: int, exc: BaseException, delay: float) -> None:
+        sys.stderr.write(
+            f"bench_watch: attempt {attempt} failed ({exc}); "
+            f"next probe in {delay:.0f}s\n"
+        )
+
     try:
         result = retry_call(
             attempt_once, policy, retry_on=(_NoEvidence,),
-            description="tpu evidence probe",
+            on_retry=on_retry, description="tpu evidence probe",
         )
-    except RetryBudgetExceeded:
-        sys.stderr.write("bench_watch: budget exhausted, no TPU evidence\n")
-        # Even a fruitless watch leaves its wedge tally in the ledger —
-        # "the tunnel was dead all night" is itself trend data.
-        _record_ledger({
-            "probe_attempts_made": seen["n"],
-        })
+    except RetryBudgetExceeded as e:
+        sys.stderr.write(f"bench_watch: {e}; no TPU evidence\n")
         return 1
 
     result["captured_by"] = "tools/bench_watch.py"
