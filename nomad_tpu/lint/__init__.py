@@ -6,10 +6,12 @@ Three AST passes over the production tree, one runtime sanitizer:
   per-function lock-acquisition graphs across ``server/``,
   ``scheduler/``, ``state/``, ``client/``, ``stream/``, checked against
   the declared hierarchy in :mod:`.lock_order`.
-* **JAX hot path** (:mod:`.jaxpass`, rules ``J001``–``J003``) — implicit
-  host syncs on device values, jit-captured mutable globals, and
-  non-hashable static args in ``ops/``, ``parallel/``,
-  ``scheduler/coalescer.py``, ``state/matrix.py``.
+* **JAX hot path** (:mod:`.jaxpass`, rules ``J001``–``J005``) — implicit
+  host syncs on device values, jit-captured mutable globals,
+  non-hashable static args, fused-path recompile triggers, and
+  node-axis-shaped host fetches at fused/sharded call sites in
+  ``ops/``, ``parallel/``, ``scheduler/coalescer.py``,
+  ``state/matrix.py``.
 * **chaos seams** (:mod:`.chaospass`, rules ``C001``–``C004``) — the
   CHAOS.md seam catalog and retry surface cross-checked against the
   injector call sites and the tests that exercise them.

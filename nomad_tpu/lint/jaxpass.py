@@ -1,4 +1,4 @@
-"""JAX hot-path pass (rules J001–J004).
+"""JAX hot-path pass (rules J001–J005).
 
 The live dispatch path stays fast only while two disciplines hold: no
 implicit device→host sync outside the resolver thread (each one stalls
@@ -34,6 +34,18 @@ This pass enforces both lexically over ``ops/``, ``parallel/``,
   XLA compile mid-dispatch.  Preallocate a ``(B, ...)`` operand slab
   (``ops.encode.RequestSlab``), mask dead lanes with ``lane_mask``, and
   keep static args bound to configuration constants.
+* **J005 node-axis fetch at a fused/sharded call site** — a function that
+  drives the fused or node-sharded dispatch entry points
+  (``fused_place_batch[_live]`` / ``sharded_[fused_]place_batch``) also
+  fetches a node-axis-shaped value to host: a sync sink
+  (``np.asarray``/``.block_until_ready()``/…) applied to a
+  ``DeviceArrays`` leaf (``arrays.used``, ``.totals``, ``.attr_hash``,
+  …) or a node-shaped ``PlacementResult`` field (``used_after``,
+  ``tg_count_after``).  The sharded megabatch contract
+  (parallel/sharding.py) is that only the packed (B, P, 8) winner block
+  ever crosses the device→host boundary; an (…, N) fetch reintroduces
+  O(nodes) host traffic per dispatch and scales with cluster size —
+  exactly what hierarchical top-k exists to prevent.
 """
 
 from __future__ import annotations
@@ -71,6 +83,27 @@ STACKING_CALL_NAMES = {
 # Static params of the fused entry points (mirrors ops/kernels.py); a
 # batch-derived value here keys a fresh compile per occupancy.
 FUSED_STATIC_PARAMS = ("n_placements", "features")
+
+# J005: the node-sharded dispatch builders — a function calling any of
+# these (or the fused entries above) is "on the fused/sharded path" and
+# must never fetch node-axis-shaped arrays to host.  ``_sharded_fused_fn``
+# is the coalescer's bound callable built by ``sharded_fused_place_batch``
+# — the production dispatch site invokes the entry through it, so the
+# bound name counts as an entry too.
+SHARDED_ENTRY_NAMES = {
+    "sharded_place_batch",
+    "sharded_fused_place_batch",
+    "_sharded_fused_fn",
+}
+# Node-axis-shaped leaves: every DeviceArrays field (state/matrix.py) plus
+# the node-shaped PlacementResult fields (ops/kernels.py).  An attribute
+# access with one of these names is treated as (…, N)-shaped.
+NODE_AXIS_ATTRS = {
+    "totals", "used", "eligible", "attr_hash", "attr_num", "attr_ver",
+    "class_id", "dev_total", "dev_used", "prio_used", "port_words",
+    "dyn_used",
+    "used_after", "tg_count_after",
+}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -221,6 +254,24 @@ def _check_function(
     mutable_locals: Dict[str, int] = {}
     # locals bound to per-dispatch stacked arrays (for J004 via a hop)
     stacked_locals: Dict[str, int] = {}
+    # locals bound to node-axis-shaped attributes (for J005 via a hop)
+    node_axis_vars: Dict[str, int] = {}
+
+    # J005 scopes to functions that drive the fused/sharded dispatch path.
+    fused_caller = any(
+        isinstance(n, ast.Call)
+        and (_dotted(n.func) or "").rsplit(".", 1)[-1]
+        in (FUSED_ENTRY_NAMES | SHARDED_ENTRY_NAMES)
+        and not (_dotted(n.func) or "").startswith("fake_device.")
+        for n in ast.walk(fn)
+    )
+
+    def _node_axis_expr(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and expr.attr in NODE_AXIS_ATTRS:
+            return _dotted(expr) or f".{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in node_axis_vars:
+            return expr.id
+        return None
 
     statics = _jit_decorator_statics(fn)
     if statics:
@@ -243,6 +294,11 @@ def _check_function(
             if isinstance(t, ast.Name):
                 if _varlen_stack_call(node.value):
                     stacked_locals[t.id] = node.lineno
+                if (
+                    isinstance(node.value, ast.Attribute)
+                    and node.value.attr in NODE_AXIS_ATTRS
+                ):
+                    node_axis_vars[t.id] = node.lineno
                 if _is_device_call(node.value, jitted_names):
                     device_vars.add(t.id)
                 elif _mutable_display(node.value):
@@ -286,6 +342,35 @@ def _check_function(
                 f"fetches through the resolver thread",
             ))
             continue
+
+        # J005: node-axis-shaped operand fetched to host in a function
+        # that drives the fused/sharded dispatch path.
+        if fused_caller:
+            tgt: Optional[str] = None
+            if d in SYNC_DOTTED or (
+                isinstance(node.func, ast.Name)
+                and node.func.id in SYNC_CALL_NAMES
+            ):
+                for a in node.args:
+                    tgt = _node_axis_expr(a)
+                    if tgt:
+                        break
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS
+            ):
+                tgt = _node_axis_expr(node.func.value)
+            if tgt is not None:
+                findings.append(Finding(
+                    "J005", info.path, node.lineno, symbol,
+                    f"node-axis-shaped value '{tgt}' fetched to host at a "
+                    f"fused/sharded call site — only the packed "
+                    f"(B, P, 8) winner block may cross the device->host "
+                    f"boundary; an (..., N) fetch is O(nodes) host "
+                    f"traffic per dispatch (see parallel/sharding.py "
+                    f"hierarchical top-k)",
+                ))
+                continue
 
         # J004: per-eval recompile triggers at fused-megakernel call
         # sites. The fake-device twin has no compile cache, so its calls

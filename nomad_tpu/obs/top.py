@@ -107,6 +107,31 @@ def render(
         f"  lane fill {_num(metrics, 'nomad.coalescer.lane_fill_ratio'):.2f}"
         f"  stale {int(_num(metrics, 'nomad.coalescer.stale_dispatches'))}"
     )
+    shard_rows = []
+    for key, v in metrics.items():
+        if key.startswith("nomad.matrix.shard_rows{") and isinstance(
+            v, (int, float)
+        ):
+            try:
+                shard_rows.append(
+                    (int(key.rsplit("=", 1)[1].rstrip("}")), int(v))
+                )
+            except ValueError:
+                continue
+    shard_rows.sort()
+    if len(shard_rows) > 1:
+        # Shard balance: claimed rows per home shard plus the max/mean
+        # skew — a hot shard ranks/scores more rows per dispatch than the
+        # rest of the mesh, so skew IS the sharded-path straggler gauge.
+        counts = [c for _, c in shard_rows]
+        mean = sum(counts) / len(counts)
+        skew = (max(counts) / mean) if mean else 1.0
+        lines.append(
+            f"shards  : rows {'/'.join(str(c) for c in counts)}"
+            f"  skew {skew:.2f}"
+            f"  topk host bytes "
+            f"{int(_num(metrics, 'nomad.topk.host_bytes_total'))}"
+        )
     if overload:
         p = overload.get("pressure", {})
         act = overload.get("actuators", {})

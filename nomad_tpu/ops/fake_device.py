@@ -783,6 +783,33 @@ def fused_place_batch(arrays, used, delta_rows: List[np.ndarray],
     return out
 
 
+def sharded_fused_place_batch(arrays, used, delta_rows, delta_vals,
+                              tg_counts, spread_counts, penalties, reqs,
+                              class_eligs, host_masks, lane_mask,
+                              n_shards: int, n_placements: int,
+                              live_counts=None) -> np.ndarray:
+    """Twin of parallel.sharding.sharded_fused_place_batch for host-only CI.
+
+    The sharded kernel's hierarchical top-k election (per-shard stable
+    top-k → cross-shard pmax/pmin of the (shards, k) candidate table,
+    shard-major row-minor tie-break) provably reproduces the dense argmax
+    row-for-row, and its owner-veto verify reproduces the sequential
+    cross-lane AllocsFit scan (PARITY.md "Hierarchical top-k") — so the
+    bit-compatible numpy reference IS the dense twin, run after validating
+    the shard partition the mesh would impose.
+    """
+    n = int(np.asarray(used).shape[0])
+    if n_shards < 1 or n % n_shards:
+        raise ValueError(
+            f"node axis of {n} rows does not split into {n_shards} shards"
+        )
+    return fused_place_batch(
+        arrays, used, delta_rows, delta_vals, tg_counts, spread_counts,
+        penalties, reqs, class_eligs, host_masks, lane_mask,
+        n_placements=n_placements, live_counts=live_counts,
+    )
+
+
 def system_feasible(arrays, used0, req: SchedRequest, class_elig,
                     host_mask) -> np.ndarray:
     """Twin of kernels.system_feasible — stacked (2, N) [mask, fits]."""

@@ -937,6 +937,32 @@ FUSED_PACKED_VERIFIED = 7
 FUSED_PACKED_WIDTH = 8
 
 
+def pack_fused_lanes(
+    rows, scores, binpack, preempted, n_eval, n_filt, n_exh, verified, live
+):
+    """Stack per-lane placement outputs into the fused (B, P, 8) layout with
+    dead-lane masking: row/-1, VERIFIED/-1.0, zeros elsewhere.  Shared by the
+    single-device fused kernel and the shard_map local body
+    (parallel/sharding.py) so the two paths cannot drift column-wise —
+    tests/test_parallel.py asserts bitwise parity across them.
+    """
+    lv = live[:, None]
+    vcol = jnp.where(lv, verified.astype(jnp.float32), -1.0)
+    return jnp.stack(
+        [
+            rows.astype(jnp.float32),
+            jnp.where(lv, scores, 0.0),
+            jnp.where(lv, binpack, 0.0),
+            jnp.where(lv, preempted, False).astype(jnp.float32),
+            jnp.where(lv, n_eval, 0).astype(jnp.float32),
+            jnp.where(lv, n_filt, 0).astype(jnp.float32),
+            jnp.where(lv, n_exh, 0).astype(jnp.float32),
+            vcol,
+        ],
+        axis=2,
+    )  # (B, P, FUSED_PACKED_WIDTH)
+
+
 def _fused_place_batch_impl(
     arrays,
     used,
@@ -1020,21 +1046,10 @@ def _fused_place_batch_impl(
         lane_step, used, (rows, reqs.ask, delta_rows, delta_vals, live)
     )  # (B, P) bool
 
-    lv = live[:, None]
-    vcol = jnp.where(lv, verified.astype(jnp.float32), -1.0)
-    return jnp.stack(
-        [
-            rows.astype(jnp.float32),
-            jnp.where(lv, res.scores, 0.0),
-            jnp.where(lv, res.binpack, 0.0),
-            jnp.where(lv, res.preempted, False).astype(jnp.float32),
-            jnp.where(lv, res.nodes_evaluated, 0).astype(jnp.float32),
-            jnp.where(lv, res.nodes_filtered, 0).astype(jnp.float32),
-            jnp.where(lv, res.nodes_exhausted, 0).astype(jnp.float32),
-            vcol,
-        ],
-        axis=2,
-    )  # (B, P, 8)
+    return pack_fused_lanes(
+        rows, res.scores, res.binpack, res.preempted, res.nodes_evaluated,
+        res.nodes_filtered, res.nodes_exhausted, verified, live,
+    )
 
 
 fused_place_batch = functools.partial(

@@ -11,6 +11,7 @@ from .sharding import (
     build_batch_inputs,
     make_mesh,
     shard_matrix_arrays,
+    sharded_fused_place_batch,
     sharded_place_batch,
     sharded_schedule_step,
     stack_requests,
@@ -20,6 +21,7 @@ __all__ = [
     "build_batch_inputs",
     "make_mesh",
     "shard_matrix_arrays",
+    "sharded_fused_place_batch",
     "sharded_place_batch",
     "sharded_schedule_step",
     "stack_requests",

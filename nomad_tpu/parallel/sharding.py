@@ -37,12 +37,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.encode import SchedRequest, pow2_bucket
 from ..ops.kernels import (
+    FULL_FEATURES,
     NEG_INF,
     apply_spread_values,
+    pack_fused_lanes,
     score_nodes,
     spread_values_at,
 )
 from ..state.matrix import DeviceArrays
+
+# Hierarchical top-k width: each node shard contributes its k best rows to
+# the (shards, k) candidate table.  Any k >= 1 preserves exact argmax parity
+# (the global winner is always some shard's per-shard maximum, and
+# jax.lax.top_k is stable so the lowest-index occurrence of that maximum is
+# always in the table); PARITY.md "Hierarchical top-k" documents the
+# tie-break proof.  k = 1 is the fast path: XLA lowers top_k with k > 1
+# inside the shard_map scan to a full sort of the (n_local,) scores —
+# measured 2x end-to-end on the 100K-node sweep — while k = 1 stays the
+# single-pass max+argmax.  Widen only for a future multi-winner selection
+# that actually consumes the extra rows.
+TOPK_K = 1
 
 
 def make_mesh(
@@ -441,3 +455,220 @@ def sharded_place_batch(mesh: Mesh, n_placements: int):
         out_specs=P("batch", None, None),
     )
     return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Sharded FUSED megakernel (hierarchical top-k + sharded AllocsFit verify)
+# ---------------------------------------------------------------------------
+
+
+def _fused_place_batch_local(
+    arrays, used, delta_rows, delta_vals, tg_counts, spread_counts,
+    penalties, reqs, class_eligs, host_masks, lane_mask, n_placements,
+    features,
+):
+    """Per-shard body of ``kernels.fused_place_batch`` under a
+    ('batch', 'node') mesh — the full megakernel (ranking scan + cross-lane
+    AllocsFit re-verify) with the node axis partitioned.
+
+    Ranking is a hierarchical top-k: each shard scores only its local node
+    slice and contributes its ``k = min(TOPK_K, n_local)`` best rows via one
+    ``all_gather`` over ICI, producing a tiny (shards, k) candidate table
+    replicated on every shard.  The global winner is the table's max score,
+    ties broken to the LOWEST global row — ``jax.lax.top_k`` is stable
+    (lower index first on ties), so the per-shard maximum's lowest local
+    occurrence is always in the table and the min-over-ties selection
+    reproduces the single-device ``jnp.argmax`` bit-for-bit (PARITY.md
+    "Hierarchical top-k").  No (B, N) score tensor ever exists globally:
+    per-shard intermediates are (n_local,) and everything crossing the
+    interconnect or reaching the host is O(B · P) or (shards, k).
+
+    The cross-lane verify gathers only winner rows + asks + in-flight
+    deltas over the batch axis (all O(B · P), node-shape-free), scans all B
+    lanes against the LOCAL (n_local, 3) usage slice with non-owned rows
+    vacuously fitting, and combines verdicts with a single ``pmin`` over
+    the node axis — each row's owner alone decides.
+    """
+    n_local = used.shape[0]
+    shard = jax.lax.axis_index("node")
+    row_offset = shard * n_local
+    big = jnp.int32(2 ** 30)
+    k = min(TOPK_K, n_local)
+
+    def one(drows, dvals, tg, sc, pen, req, ce, hm):
+        local = drows - row_offset
+        mine = (drows >= 0) & (local >= 0) & (local < n_local)
+        safe = jnp.clip(local, 0, n_local - 1)
+        used0 = used.at[safe].add(jnp.where(mine[:, None], dvals, 0.0))
+
+        def step(carry, _):
+            u, tg_cnt, s_hash, s_counts = carry
+            req_step = req._replace(s_value_hash=s_hash)
+            res = score_nodes(
+                arrays, u, tg_cnt, s_counts, pen, req_step, ce, hm,
+                features=features,
+            )
+            # Hierarchical top-k: (n_local,) -> per-shard (k,) candidates,
+            # then a cross-shard reduce of the implicit (shards, k) table —
+            # pmax elects the winning score, pmin the lowest owning row.
+            vals, idxs = jax.lax.top_k(res.final, k)
+            best = jax.lax.pmax(vals[0], "node")
+            ok = best > NEG_INF / 2
+            cand = jnp.where(
+                vals == best, row_offset + idxs.astype(jnp.int32), big
+            )
+            grow = jax.lax.pmin(jnp.min(cand), "node")  # lowest row on ties
+            grow = jnp.where(ok, grow, -1)
+            owner = ok & (grow >= row_offset) & (grow < row_offset + n_local)
+            lwin = jnp.clip(grow - row_offset, 0, n_local - 1)
+
+            n_eval = jax.lax.psum(
+                jnp.sum(res.feasible.astype(jnp.int32)), "node"
+            )
+            n_filt = jax.lax.psum(
+                jnp.sum((~res.feasible & arrays.eligible).astype(jnp.int32)),
+                "node",
+            )
+            n_exh = jax.lax.psum(
+                jnp.sum((res.feasible & ~res.fits).astype(jnp.int32)), "node"
+            )
+
+            u2 = jnp.where(owner, u.at[lwin].add(req.ask), u)
+            tg2 = jnp.where(owner, tg_cnt.at[lwin].add(1), tg_cnt)
+
+            nvals = jnp.where(
+                owner, spread_values_at(arrays, req_step, lwin), 0
+            )
+            nvals = jax.lax.psum(nvals, "node")
+            new_hash, new_counts = apply_spread_values(
+                s_counts, req_step, nvals
+            )
+            s_hash2 = jnp.where(ok, new_hash, s_hash)
+            s_counts2 = jnp.where(ok, new_counts, s_counts)
+
+            binp = jax.lax.psum(
+                jnp.where(owner, res.binpack[lwin], 0.0), "node"
+            )
+            pre = jax.lax.pmax(
+                jnp.where(
+                    owner, res.needs_preempt[lwin], False
+                ).astype(jnp.int32),
+                "node",
+            ).astype(bool)
+            out = (
+                grow,
+                jnp.where(ok, best, 0.0),
+                jnp.where(ok, binp, 0.0),
+                pre & ok,
+                n_eval,
+                n_filt,
+                n_exh,
+            )
+            return (u2, tg2, s_hash2, s_counts2), out
+
+        init = (used0, tg, req.s_value_hash, sc)
+        _, outs = jax.lax.scan(step, init, None, length=n_placements)
+        return outs  # each (P,)
+
+    rows, scores, binpack, pre, ne, nf, nx = jax.vmap(one)(
+        delta_rows, delta_vals, tg_counts, spread_counts, penalties, reqs,
+        class_eligs, host_masks,
+    )
+    live = lane_mask  # (b_local,)
+    rows = jnp.where(live[:, None], rows, -1)  # (b_local, P)
+
+    # Cross-lane AllocsFit re-verify, sharded: every tensor gathered over
+    # the batch axis is winner-row-shaped — (B, P) rows, (B, 3) asks,
+    # (B, K) / (B, K, 3) in-flight deltas, (B,) liveness — never node-axis
+    # shaped.  Each node shard then replays all B lanes in resolve order
+    # against its local (n_local, 3) usage slice; rows it does not own fit
+    # vacuously, and one pmin over 'node' lets each row's owner veto.
+    g_rows = jax.lax.all_gather(rows, "batch", tiled=True)  # (B, P)
+    g_ask = jax.lax.all_gather(reqs.ask, "batch", tiled=True)  # (B, 3)
+    g_drows = jax.lax.all_gather(delta_rows, "batch", tiled=True)  # (B, K)
+    g_dvals = jax.lax.all_gather(delta_vals, "batch", tiled=True)
+    g_live = jax.lax.all_gather(live, "batch", tiled=True)  # (B,)
+
+    def lane_step(cum_used, lane):
+        l_rows, l_ask, l_drows, l_dvals, l_live = lane
+        l_local = l_drows - row_offset
+        l_mine = (
+            (l_drows >= 0) & (l_local >= 0) & (l_local < n_local) & l_live
+        )
+        l_safe = jnp.clip(l_local, 0, n_local - 1)
+        base = cum_used.at[l_safe].add(
+            jnp.where(l_mine[:, None], l_dvals, 0.0)
+        )
+
+        def p_step(u, row):
+            p_local = row - row_offset
+            p_mine = (
+                (row >= 0) & (p_local >= 0) & (p_local < n_local) & l_live
+            )
+            p_safe = jnp.clip(p_local, 0, n_local - 1)
+            u2 = u.at[p_safe].add(jnp.where(p_mine, l_ask, 0.0))
+            fit = jnp.all(u2[p_safe] <= arrays.totals[p_safe]) | ~p_mine
+            return u2, fit
+
+        after, fits = jax.lax.scan(p_step, base, l_rows)
+        return jnp.where(l_live, after, cum_used), fits
+
+    _, fits_all = jax.lax.scan(
+        lane_step, used, (g_rows, g_ask, g_drows, g_dvals, g_live)
+    )  # (B, P) bool, identical on every node shard only after the pmin:
+    verified = jax.lax.pmin(fits_all.astype(jnp.int32), "node")  # (B, P)
+
+    b_local = rows.shape[0]
+    b_idx = jax.lax.axis_index("batch")
+    v_local = jax.lax.dynamic_slice_in_dim(
+        verified, b_idx * b_local, b_local, axis=0
+    )  # (b_local, P)
+    return pack_fused_lanes(
+        rows, scores, binpack, pre, ne, nf, nx, v_local, live
+    )
+
+
+def sharded_fused_place_batch(mesh: Mesh, n_placements: int):
+    """Build the jitted SPMD twin of ``kernels.fused_place_batch``.
+
+    Same signature (``features`` keyword-static) and packed
+    (B, P, FUSED_PACKED_WIDTH) result as the single-device fused kernel —
+    the dispatch coalescer swaps it in when a mesh is configured and
+    ``NOMAD_TPU_SHARDED_MEGABATCH`` is not disabled.  Placement AND
+    verify-column parity with the unsharded kernel is exact (tie-breaks
+    included) — tests/test_parallel.py asserts it across shard counts.
+    """
+
+    def entry(
+        arrays, used, delta_rows, delta_vals, tg_counts, spread_counts,
+        penalties, reqs, class_eligs, host_masks, lane_mask, *,
+        features=FULL_FEATURES,
+    ):
+        fn = shard_map(
+            functools.partial(
+                _fused_place_batch_local,
+                n_placements=n_placements,
+                features=features,
+            ),
+            mesh=mesh,
+            in_specs=(
+                _ARRAYS_SPEC,
+                P("node", None),  # used
+                P("batch", None),  # delta_rows (global ids)
+                P("batch", None, None),  # delta_vals
+                P("batch", "node"),  # tg_counts
+                P("batch", None, None),  # spread_counts
+                P("batch", "node"),  # penalties
+                _REQS_SPEC,
+                P("batch", None),  # class_eligs
+                P("batch", "node"),  # host_masks
+                P("batch"),  # lane_mask
+            ),
+            out_specs=P("batch", None, None),
+        )
+        return fn(
+            arrays, used, delta_rows, delta_vals, tg_counts, spread_counts,
+            penalties, reqs, class_eligs, host_masks, lane_mask,
+        )
+
+    return jax.jit(entry, static_argnames=("features",))

@@ -525,7 +525,14 @@ class GenericStack:
         """Run one placement scan; returns host-side arrays (rows, scores,
         binpack, preempted, n_eval, n_filt, n_exh, fit_verified) of scan
         length ≥ the bucket for ``remaining``.  fit_verified is None unless
-        the fused megakernel path supplied its cross-lane verify column."""
+        the fused megakernel path supplied its cross-lane verify column.
+
+        With a mesh configured the coalescer routes the batch through the
+        node-sharded fused entry (parallel/sharding.py, hierarchical
+        top-k); either way the rows returned here are GLOBAL and already
+        translated through any shard-preserving capacity growth that
+        happened while the dispatch was in flight (matrix.translate_rows),
+        so the node_of lookup below never sees a pre-relocation id."""
         from .coalescer import MAX_DELTA_ROWS, megabatch_enabled
 
         # One consistent width for every per-node array in this request:

@@ -277,6 +277,22 @@ class Server:
         m.gauge_fn(
             "nomad.kernel.operand_bytes_total", lambda: c.operand_bytes_total
         )
+        # Node-axis sharding: per-home-shard claimed-row balance (more
+        # series appear if the coalescer homes the matrix to a wider mesh
+        # at first dispatch) and the device→host result traffic — packed
+        # (B, P, 8) winner blocks only, never node-axis shaped (lint rule
+        # J005 guards the call sites).
+        for s in range(mx.shard_count):
+            m.gauge_fn(
+                "nomad.matrix.shard_rows",
+                lambda s=s: (
+                    mx.shard_row_counts()[s] if s < mx.shard_count else 0
+                ),
+                shard=s,
+            )
+        m.gauge_fn(
+            "nomad.topk.host_bytes_total", lambda: c.topk_host_bytes_total
+        )
 
     # ------------------------------------------------------------------
     # Consensus (server/replication.py)

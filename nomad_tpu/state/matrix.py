@@ -307,6 +307,21 @@ class NodeMatrix:
         self._sharded_dirty: set = set()
         self._sharded_mesh = None
         self._sharded_scatter = None
+        # Node-axis sharding (parallel/sharding.py): the capacity splits
+        # into shard_count equal row blocks, one per mesh 'node' shard.
+        # Row claims balance across blocks and _grow relocates rows so a
+        # node's (home_shard, local_offset) pair survives capacity growth —
+        # the sharded device mirror never sees a row migrate between
+        # shards.  shard_count == 1 is the exact legacy dense policy.
+        self.shard_count = 1
+        self._shard_next: List[int] = [0]
+        self._shard_claimed: List[int] = [0]
+        # Row-relocation history: (version_after_remap, mapping) pairs, so
+        # in-flight dispatches that recorded GLOBAL rows against an older
+        # version can translate them (translate_rows).  Bounded window;
+        # anything older resolves to -1 (= placement failed, stack retries).
+        self._remaps: List[Tuple[int, np.ndarray]] = []
+        self._remap_floor = 0
         # Guards _alloc row writes + _dirty against the sync drain: store
         # mutators run under the store lock, sync under DEVICE_LOCK — with
         # no common lock, a row marked dirty while sync snapshots the set
@@ -381,13 +396,56 @@ class NodeMatrix:
             "dyn_used": np.zeros((cap,), np.int32),
         }
 
+    # How many row-relocation mappings translate_rows keeps.  A dispatch
+    # outlives at most a handful of growth doublings; anything older maps
+    # to -1 (failed placement, retried) rather than a silently wrong row.
+    _REMAP_KEEP = 16
+
     def _grow(self, min_cap: int) -> None:
         new_cap = self.capacity
         while new_cap < min_cap:
             new_cap *= 2
         new = self._allocate_arrays(new_cap)
-        for k, arr in self._alloc.items():
-            new[k][: self.capacity] = arr
+        if self.shard_count > 1:
+            # Shard-preserving relocation: row r of shard s sits at offset
+            # (r - s·old_blk) inside its block; it moves to the SAME offset
+            # of the SAME shard's doubled block, so (home_shard, offset)
+            # survives growth and the mesh layout never migrates a node
+            # between shards.  The mapping is recorded so in-flight
+            # dispatches can translate rows they scored pre-growth.
+            old_blk = self.capacity // self.shard_count
+            new_blk = new_cap // self.shard_count
+            rows = np.arange(self.capacity, dtype=np.int64)
+            mapping = ((rows // old_blk) * new_blk + rows % old_blk).astype(
+                np.int32
+            )
+            for k, arr in self._alloc.items():
+                new[k][mapping] = arr
+            self.row_of = {
+                nid: int(mapping[r]) for nid, r in self.row_of.items()
+            }
+            self.node_of = {r: nid for nid, r in self.row_of.items()}
+            self._free = [int(mapping[r]) for r in self._free]
+            self._dirty = {int(mapping[r]) for r in self._dirty}
+            self._sharded_dirty = {
+                int(mapping[r]) for r in self._sharded_dirty
+            }
+            self._shard_next = [
+                s * new_blk + (nxt - s * old_blk)
+                for s, nxt in enumerate(self._shard_next)
+            ]
+            self._next_row = max(
+                (r + 1 for r in self.node_of), default=0
+            )
+            self.version += 1
+            self._remaps.append((self.version, mapping))
+            if len(self._remaps) > self._REMAP_KEEP:
+                dropped = self._remaps[: -self._REMAP_KEEP]
+                self._remap_floor = dropped[-1][0]
+                del self._remaps[: -self._REMAP_KEEP]
+        else:
+            for k, arr in self._alloc.items():
+                new[k][: self.capacity] = arr
         self._alloc = new
         self.capacity = new_cap
         self._device_valid = False
@@ -397,11 +455,84 @@ class NodeMatrix:
     def n_rows(self) -> int:
         return self._next_row
 
+    def set_shard_count(self, n: int) -> None:
+        """Partition the row space into ``n`` equal home-shard blocks
+        (block b = rows [b·capacity/n, (b+1)·capacity/n)), matching the
+        mesh 'node' axis size.  Subsequent claims balance across blocks
+        and growth preserves each row's home shard.  ``n`` must divide
+        capacity; ``n == 1`` restores the dense legacy policy."""
+        n = max(1, int(n))
+        with self._host_lock:
+            if n == self.shard_count:
+                return
+            if self.capacity % n:
+                raise ValueError(
+                    f"shard_count {n} does not divide capacity "
+                    f"{self.capacity}"
+                )
+            self.shard_count = n
+            blk = self.capacity // n
+            self._shard_next = [s * blk for s in range(n)]
+            self._shard_claimed = [0] * n
+            for r in self.node_of:
+                self._shard_claimed[r // blk] += 1
+
+    def home_shard(self, row: int) -> int:
+        """The mesh shard owning ``row`` under the current partition."""
+        return row // (self.capacity // self.shard_count)
+
+    def shard_row_counts(self) -> List[int]:
+        """Claimed-row count per home shard (the shard-balance gauge)."""
+        with self._host_lock:
+            if self.shard_count == 1:
+                return [len(self.node_of)]
+            return list(self._shard_claimed)
+
+    def shard_nodes(self, shard: int) -> List[str]:
+        """Node ids homed on ``shard`` — the chaos ``shard.partition``
+        seam's blast-radius surface (scheduler/coalescer.py)."""
+        with self._host_lock:
+            blk = self.capacity // self.shard_count
+            return [
+                nid for r, nid in self.node_of.items() if r // blk == shard
+            ]
+
+    def translate_rows(
+        self, rows: np.ndarray, from_version: int
+    ) -> np.ndarray:
+        """Map GLOBAL row ids recorded at matrix ``from_version`` through
+        every shard-preserving relocation since.  Rows whose provenance
+        predates the tracked remap window become -1 (the caller treats
+        that as a failed placement and retries); negative rows pass
+        through untouched."""
+        with self._host_lock:
+            remaps = [
+                (ver, mp) for ver, mp in self._remaps if ver > from_version
+            ]
+            floor = self._remap_floor
+        if not remaps:
+            return rows
+        out = np.array(rows, np.int64, copy=True)
+        pos = out >= 0
+        if from_version < floor:
+            out[pos] = -1
+            return out.astype(rows.dtype, copy=False)
+        for _ver, mapping in remaps:
+            ok = pos & (out >= 0) & (out < len(mapping))
+            out = np.where(
+                ok,
+                mapping[np.clip(out, 0, len(mapping) - 1)],
+                np.where(pos, -1, out),
+            )
+        return out.astype(rows.dtype, copy=False)
+
     def _claim_row(self, node_id: str) -> int:
         row = self.row_of.get(node_id)
         if row is not None:
             return row
-        if self._free:
+        if self.shard_count > 1:
+            row = self._claim_sharded_row_locked()
+        elif self._free:
             row = self._free.pop()
         else:
             if self._next_row >= self.capacity:
@@ -411,6 +542,35 @@ class NodeMatrix:
         self.row_of[node_id] = row
         self.node_of[row] = node_id
         return row
+
+    def _claim_sharded_row_locked(self) -> int:
+        """Claim a row on the least-occupied home shard: a freed row in
+        that shard's block if any, else the block's claim cursor.  Falls
+        through fuller shards before growing (doubling every block)."""
+        blk = self.capacity // self.shard_count
+        order = sorted(
+            range(self.shard_count),
+            key=lambda s: (self._shard_claimed[s], s),
+        )
+        for s in order:
+            lo, hi = s * blk, (s + 1) * blk
+            for i in range(len(self._free) - 1, -1, -1):
+                r = self._free[i]
+                if lo <= r < hi:
+                    del self._free[i]
+                    self._shard_claimed[s] += 1
+                    self._next_row = max(self._next_row, r + 1)
+                    return r
+            nxt = max(self._shard_next[s], lo)
+            while nxt < hi and nxt in self.node_of:
+                nxt += 1
+            if nxt < hi:
+                self._shard_next[s] = nxt + 1
+                self._shard_claimed[s] += 1
+                self._next_row = max(self._next_row, nxt + 1)
+                return nxt
+        self._grow(self.capacity + 1)
+        return self._claim_sharded_row_locked()
 
     # -- mutations ----------------------------------------------------------
 
@@ -437,6 +597,9 @@ class NodeMatrix:
             self._device_valid = False
             self._sharded_dirty.clear()
             self._sharded_valid = False
+            blk = self.capacity // self.shard_count
+            self._shard_next = [s * blk for s in range(self.shard_count)]
+            self._shard_claimed = [0] * self.shard_count
             self.version += 1
 
     def upsert_node(self, node: Node) -> int:
@@ -533,6 +696,8 @@ class NodeMatrix:
         self._alloc["class_id"][row] = -1
         self._alloc["prio_used"][row] = 0
         self._free.append(row)
+        if self.shard_count > 1:
+            self._shard_claimed[self.home_shard(row)] -= 1
         self._mark_dirty_locked(row)
 
     def _usage_of(self, alloc: Allocation) -> np.ndarray:
@@ -650,6 +815,7 @@ class NodeMatrix:
                 "format": self.ENCODED_FORMAT,
                 "capacity": self.capacity,
                 "next_row": self._next_row,
+                "shard_count": self.shard_count,
                 "free": list(self._free),
                 "row_of": self.row_of,
                 "class_ids": self.class_ids,
@@ -713,6 +879,12 @@ class NodeMatrix:
             self._sharded_valid = False
             self._shared_masks = None
             self._shared_zero_i32 = None
+            self.shard_count = max(1, int(meta.get("shard_count", 1)))
+            blk = self.capacity // self.shard_count
+            self._shard_next = [s * blk for s in range(self.shard_count)]
+            self._shard_claimed = [0] * self.shard_count
+            for r in self.node_of:
+                self._shard_claimed[r // blk] += 1
             self.version += 1
         return True
 
@@ -871,6 +1043,12 @@ class NodeMatrix:
                 return self._sharded_device
             rows = np.fromiter(self._sharded_dirty, np.int32)
             self._sharded_dirty.clear()
+            # Per-shard scatter buckets: home-shard blocks are contiguous
+            # row ranges, so an ascending sort groups each shard's updates
+            # into one dense run of the index vector — the sharding-aware
+            # scatter then issues one contiguous block per shard instead
+            # of interleaved single-row transfers.
+            rows.sort()
             # Pow2 row-count buckets, as in _sync_locked, so the sharded
             # scatter compiles once per bucket.
             k = len(rows)

@@ -297,6 +297,103 @@ class TestSeams:
         wal.close()
 
 
+class TestShardPartitionSeam:
+    @staticmethod
+    def _inputs(m, job):
+        import numpy as np
+
+        from nomad_tpu.ops.encode import RequestEncoder
+        from nomad_tpu.scheduler.coalescer import MAX_DELTA_ROWS
+
+        enc = RequestEncoder(m)
+        compiled = enc.compile(job, job.task_groups[0])
+        n = m.capacity
+        return dict(
+            request=compiled.request,
+            delta_rows=np.full((MAX_DELTA_ROWS,), -1, np.int32),
+            delta_vals=np.zeros((MAX_DELTA_ROWS, 3), np.float32),
+            tg_count=np.zeros((n,), np.int32),
+            spread_counts=np.zeros_like(compiled.request.s_desired),
+            penalty=np.zeros((n,), bool),
+            class_elig=np.ones((2,), bool),
+            host_mask=np.ones((n,), bool),
+        )
+
+    def test_dark_shard_placements_rejected_then_heal(self, monkeypatch):
+        """``shard.partition`` darkens a whole matrix home-shard MID-
+        dispatch: the in-flight launch scored against the pre-dark
+        snapshot and still proposes placements, the serialized applier's
+        eligibility re-verify rejects any landing on the dark shard, and
+        after ``heal_shard_partitions()`` the same placement commits with
+        every store invariant green."""
+        from nomad_tpu.scheduler.coalescer import DeviceCoalescer
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs.types import Plan
+
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICE", "1")
+        srv = Server(ServerConfig(
+            num_workers=2,
+            heartbeat_min_ttl=3600.0,
+            heartbeat_max_ttl=7200.0,
+        ))
+        srv.start()
+        try:
+            m = srv.store.matrix
+            m.set_shard_count(4)
+            nodes = [mock.node() for _ in range(12)]
+            for n in nodes:
+                srv.register_node(n)
+            # Claims balance across home shards once a partition is set.
+            assert m.shard_row_counts() == [3, 3, 3, 3]
+
+            coal = DeviceCoalescer(
+                m, max_lanes=2, linger_s=0.0, pipeline_depth=1
+            )
+            coal.start()
+            try:
+                schedule = [FaultSpec("shard.partition", "dark", count=1)]
+                with injected(seed=9, schedule=schedule) as inj:
+                    out = coal.place(**self._inputs(m, mock.job()))
+            finally:
+                coal.stop()
+            assert [f for f in inj.log if f.seam == "shard.partition"], (
+                inj.log
+            )
+            # The snapshot was synced pre-darkening, so the launch still
+            # proposed a placement — possibly onto the dark shard.
+            assert out.rows[0] >= 0
+            # Deterministic blast radius: equal claim counts tie-break to
+            # the lowest shard index.
+            assert sorted(coal._dark_shards) == [0]
+            dark_ids = set(coal._dark_shards[0])
+            assert dark_ids == set(m.shard_nodes(0))
+
+            # The applier's authoritative re-verify is eligibility-gated:
+            # a plan placing onto ANY dark-shard node must not commit.
+            dark_node = next(n for n in nodes if n.id in dark_ids)
+            j = mock.job()
+            j.task_groups[0].count = 1
+            plan = Plan(
+                job=j,
+                node_allocation={dark_node.id: [mock.alloc(j, dark_node)]},
+            )
+            res = srv.plan_applier.apply(plan)
+            assert not res.node_allocation, "dark-shard placement committed"
+
+            # Heal re-lights the shard; the identical placement commits
+            # and the invariant sweep stays green.
+            assert coal.heal_shard_partitions() == [0]
+            plan2 = Plan(
+                job=j,
+                node_allocation={dark_node.id: [mock.alloc(j, dark_node)]},
+            )
+            res2 = srv.plan_applier.apply(plan2)
+            assert res2.node_allocation, "healed shard still rejecting"
+            assert check_store(srv) == []
+        finally:
+            srv.shutdown()
+
+
 # ----------------------------------------------------------------------
 # Invariant checker units (violations built by hand against a raw store)
 # ----------------------------------------------------------------------

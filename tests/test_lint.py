@@ -428,6 +428,80 @@ class TestJ004FusedRecompile:
         assert "J004" not in _rules(fs), fs
 
 
+class TestJ005NodeAxisFetch:
+    def test_asarray_on_arrays_leaf_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def bad(self, arrays, dr, dv, reqs, lm):
+                    packed = self._sharded_fused_fn(
+                        arrays, arrays.used, dr, dv, reqs, lm,
+                    )
+                    snapshot = np.asarray(arrays.used)
+                    return packed, snapshot
+                """
+            )
+        })
+        assert "J005" in _rules(fs), fs
+
+    def test_block_until_ready_via_local_hop_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def bad(self, arrays, dr, dv, reqs, lm):
+                    u = arrays.used
+                    u.block_until_ready()
+                    return kernels.fused_place_batch(
+                        arrays, u, dr, dv, reqs, lm, n_placements=1,
+                    )
+                """
+            )
+        })
+        assert "J005" in _rules(fs), fs
+
+    def test_placement_result_node_field_fires(self):
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def bad(self, arrays, dr, dv, reqs, lm):
+                    res = sharded_place_batch(arrays, reqs, lm)
+                    return np.asarray(res.used_after)
+                """
+            )
+        })
+        assert "J005" in _rules(fs), fs
+
+    def test_packed_winner_fetch_is_clean(self):
+        # The contract-conformant fetch: only the (B, P, 8) packed winner
+        # block crosses the boundary.
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def good(self, arrays, dr, dv, reqs, lm):
+                    packed = self._sharded_fused_fn(
+                        arrays, arrays.used, dr, dv, reqs, lm,
+                    )
+                    return packed
+                """
+            )
+        })
+        assert "J005" not in _rules(fs), fs
+
+    def test_node_fetch_off_the_fused_path_is_not_j005(self):
+        # Fetching a node-axis array in a function that never drives the
+        # fused/sharded entry points is sync discipline (J001 territory),
+        # not a sharded-contract violation.
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/state/matrix.py": textwrap.dedent(
+                """
+                def snapshot_usage(self, arrays):
+                    return np.asarray(arrays.used)
+                """
+            )
+        })
+        assert "J005" not in _rules(fs), fs
+
+
 # ----------------------------------------------------------------------
 # C001–C004 — chaos seams
 # ----------------------------------------------------------------------

@@ -61,6 +61,22 @@ class TestRender:
         assert "2/8 in flight" in out
         assert "lane fill 0.75" in out
 
+    def test_shard_balance_row(self):
+        cur = _metrics()
+        cur.update({
+            "nomad.matrix.shard_rows{shard=0}": 3,
+            "nomad.matrix.shard_rows{shard=1}": 5,
+            "nomad.matrix.shard_rows{shard=2}": 4,
+            "nomad.matrix.shard_rows{shard=3}": 4,
+            "nomad.topk.host_bytes_total": 2048,
+        })
+        out = render(cur, None, None)
+        assert "rows 3/5/4/4" in out
+        assert "skew 1.25" in out  # max 5 / mean 4
+        assert "topk host bytes 2048" in out
+        # A single-shard (or unsharded) matrix renders no shard row.
+        assert "shards  :" not in render(_metrics(), None, None)
+
     def test_rates_are_deltas_between_snapshots(self):
         prev = _metrics(evals=100)
         cur = _metrics(evals=300)
