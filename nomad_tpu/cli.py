@@ -766,6 +766,8 @@ def cmd_lint(args) -> int:
         forwarded.append("--verbose")
     if args.baseline:
         forwarded += ["--baseline", args.baseline]
+    if args.jaxpr:
+        forwarded.append("--jaxpr")
     return lint_main(forwarded)
 
 
@@ -1055,10 +1057,16 @@ def build_parser() -> argparse.ArgumentParser:
     tcfg.set_defaults(fn=cmd_trace_config)
 
     lint = sub.add_parser(
-        "lint", help="static analysis: lock discipline, JAX hot path, chaos seams"
+        "lint", help="static analysis: lock discipline, JAX hot path, chaos "
+        "seams; --jaxpr adds the semantic device-contract pass"
     )
     lint.add_argument("-v", "--verbose", action="store_true")
     lint.add_argument("--baseline", default=None)
+    lint.add_argument(
+        "--jaxpr", action="store_true",
+        help="also trace the registered fused/sharded device entry points "
+        "and enforce their declared contracts (J100-J105; needs JAX)",
+    )
     lint.set_defaults(fn=cmd_lint)
     return p
 

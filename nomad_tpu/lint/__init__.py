@@ -25,11 +25,21 @@ Three AST passes over the production tree, one runtime sanitizer:
   control-loop flips stay auditable against the 429s/sheds they cause.
 * **TSan-lite** (:mod:`.tsan`) — the runtime half: lockset-checked
   shared-state wrappers enabled under the seeded chaos scenarios.
+* **jaxpr contracts** (:mod:`.jaxprpass` + :mod:`.contracts`, rules
+  ``J100``–``J105``, opt-in via ``--jaxpr`` / ``run_all(jaxpr=True)``)
+  — the semantic half of the JAX gate: every registered device entry
+  point is traced to a ClosedJaxpr under a declared configuration grid
+  and checked against its contract row (no host callbacks, output-byte
+  budget + node-count independence, nothing node-axis-shaped across the
+  mesh boundary, donation actually reaching XLA, measured compile-cache
+  cardinality).  Requires an importable JAX backend; skipped otherwise.
 
 Findings carry ``rule``, ``path:line`` and the enclosing ``symbol``;
 ``baseline.json`` allowlists deliberate exemptions by
 ``(rule, path, symbol)`` so the gate starts green and ratchets — see
-STATIC_ANALYSIS.md for the workflow.
+STATIC_ANALYSIS.md for the workflow.  The loader enforces baseline
+hygiene: duplicate keys and unsorted entries are load errors, so the
+committed file stays canonical and ``git diff`` stays reviewable.
 """
 
 from __future__ import annotations
@@ -82,8 +92,14 @@ def repo_root(start: Optional[str] = None) -> str:
         d = parent
 
 
-def run_all(root: Optional[str] = None) -> List[Finding]:
-    """Run every pass over the repo; returns findings sorted by path/line."""
+def run_all(root: Optional[str] = None, jaxpr: bool = False) -> List[Finding]:
+    """Run every pass over the repo; returns findings sorted by path/line.
+
+    ``jaxpr=True`` additionally runs the semantic contract pass
+    (:mod:`.jaxprpass`), which traces the registered device entry points
+    and therefore needs an importable JAX backend — when none is
+    present the pass contributes nothing rather than failing.
+    """
     from . import chaospass, jaxpass, lockpass, obspass
 
     root = root or repo_root()
@@ -92,6 +108,10 @@ def run_all(root: Optional[str] = None) -> List[Finding]:
     findings += jaxpass.run(root)
     findings += chaospass.run(root)
     findings += obspass.run(root)
+    if jaxpr:
+        from . import jaxprpass
+
+        findings += jaxprpass.run(root)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -123,12 +143,41 @@ class Baseline:
 
 
 def load_baseline(path: Optional[str] = None) -> Baseline:
+    """Load and validate the allowlist.
+
+    Two hygiene invariants are enforced at load time (both are
+    :class:`ValueError`):
+
+    * no duplicate ``(rule, path, symbol)`` keys — ``match()`` returns
+      the first hit, so a duplicate silently decides which ``why``
+      applies;
+    * entries sorted by ``(rule, path, symbol)`` — the committed file
+      has exactly one canonical form, so baseline diffs are
+      append/delete only.
+    """
     p = path or BASELINE_PATH
     if not os.path.exists(p):
         return Baseline()
     with open(p) as fh:
         data = json.load(fh)
-    return Baseline(entries=list(data.get("exemptions", [])))
+    entries = list(data.get("exemptions", []))
+    keys = [
+        (e.get("rule", ""), e.get("path", ""), e.get("symbol", ""))
+        for e in entries
+    ]
+    dups = sorted({k for k in keys if keys.count(k) > 1})
+    if dups:
+        raise ValueError(
+            f"baseline {p}: duplicate (rule, path, symbol) entries {dups} — "
+            "the first match wins silently, so one 'why' is dead text; "
+            "delete the duplicates"
+        )
+    if keys != sorted(keys):
+        raise ValueError(
+            f"baseline {p}: entries must be sorted by (rule, path, symbol) "
+            "so the committed file has one canonical form; re-sort it"
+        )
+    return Baseline(entries=entries)
 
 
 def split_baselined(

@@ -31,10 +31,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         "-v", "--verbose", action="store_true",
         help="also list findings the baseline suppressed",
     )
+    ap.add_argument(
+        "--jaxpr", action="store_true",
+        help="also run the semantic device-contract pass (J100-J105): "
+        "trace the registered fused/sharded entry points and check the "
+        "declared budgets, donation sets and compile-cache ratchets "
+        "(needs an importable JAX backend; skipped with a notice if "
+        "none is present)",
+    )
     args = ap.parse_args(argv)
 
+    if args.jaxpr:
+        from . import jaxprpass
+
+        if not jaxprpass.available():
+            print(
+                "nomad lint: --jaxpr requested but no JAX backend is "
+                "importable — semantic pass skipped",
+                file=sys.stderr,
+            )
+
     root = args.root or repo_root()
-    findings = run_all(root)
+    findings = run_all(root, jaxpr=args.jaxpr)
     baseline = load_baseline(args.baseline)
     new, suppressed, stale = split_baselined(findings, baseline)
 

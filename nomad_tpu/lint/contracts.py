@@ -1,0 +1,357 @@
+"""The device-contract table for the jaxpr-level semantic gate.
+
+Each :class:`DeviceContract` row declares, for one registered device
+entry point, the properties :mod:`nomad_tpu.lint.jaxprpass` proves from
+the *traced program* (not the source text):
+
+* which abstract configuration grid to trace under (two node counts so
+  J102 can assert node-count independence of the device→host tunnel);
+* the device→host output-byte budget per launch (``None`` exempts an
+  entry whose outputs are deliberately device-resident, e.g. the matrix
+  scatter);
+* the donation set — which positional operands the entry declares
+  donated, checked against what actually survives ``lower()`` /
+  ``compile()``;
+* the compile-cache ratchet — a concrete sweep (occupancy fills,
+  pow2-padded dirty-row counts) plus the max number of distinct cache
+  entries it may cost.
+
+New policy heads (ROADMAP item 4) register a row here instead of a new
+lint rule: add the entry to :func:`table` with its budget/donation/sweep
+declaration and the J101–J105 checks apply unchanged.  STATIC_ANALYSIS.md
+("Semantic passes") documents the schema and the rule catalog.
+
+Everything in this module is import-gated on JAX: importing
+:mod:`nomad_tpu.lint` stays backend-free, and :func:`table` is only
+called from :func:`jaxprpass.run` after an availability check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class Grid(NamedTuple):
+    """One point of the trace/compile configuration grid.
+
+    ``live`` is the occupancy (how many of the ``batch`` lanes carry a
+    real eval — the lane-mask fill); ``deltas`` is the in-flight
+    delta-row count K.  ``features`` is the static
+    :class:`nomad_tpu.ops.kernels.Features` bucket (``None`` for entry
+    points that take no feature switch, e.g. the row scatter).
+    """
+
+    nodes: int
+    batch: int
+    placements: int
+    deltas: int
+    live: int
+    features: Any = None
+
+
+@dataclass(frozen=True)
+class DeviceContract:
+    """One registered device entry point and its proven properties.
+
+    ``build(grid)`` returns the jitted entry (factories like
+    ``sharded_fused_place_batch`` are rebuilt per grid; module-level
+    jitted functions just get returned).  ``operands(grid)`` returns a
+    FRESH tuple of concrete numpy operands every call — freshness
+    matters because donated entries consume their buffers during the
+    J105 sweep.  ``static_kwargs(grid)`` is the static keyword set
+    (``n_placements``/``features``) for entries that take one.
+    """
+
+    name: str
+    path: str  # repo-relative, forward slashes — Finding's path
+    build: Callable[[Grid], Callable[..., Any]]
+    operands: Callable[[Grid], Tuple[Any, ...]]
+    static_kwargs: Callable[[Grid], Dict[str, Any]]
+    trace_grids: Tuple[Grid, ...]
+    # J102: device→host bytes per launch; None = outputs are
+    # device-resident by design (budget and node-independence both skipped).
+    out_budget: Optional[Callable[[Grid], int]] = None
+    # J104: positional argnums declared donated. Checked BOTH ways — a
+    # declared-donated operand lowered undonated fires, and so does an
+    # undeclared donation.
+    donated_args: Tuple[int, ...] = ()
+    # J103: entry is ALLOWED to emit node-axis-shaped outputs across the
+    # mesh boundary (the scatter returns the resident matrix itself).
+    node_axis_outputs_ok: bool = False
+    # J103: shapes exempt from the boundary check — the declared
+    # (shards, k) candidate table of a hierarchical top-k, if a node
+    # count ever collides with it.
+    boundary_exempt_shapes: Tuple[Tuple[int, ...], ...] = ()
+    # J104: require an explicit input_output_alias in the compiled HLO.
+    # Off for the current entries: on CPU the fused kernel's donated
+    # lane operands are scratch-reusable but never output-ALIASED,
+    # because no donated aval matches the packed (B, P, 8) output.
+    expect_alias: bool = False
+    # J104/J105 run at this (small) grid; None skips both.
+    compile_grid: Optional[Grid] = None
+    # J105: concrete sweep returning the measured compile count.
+    sweep: Optional[Callable[[Callable[..., Any], "DeviceContract"], int]] = None
+    max_compiles: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Concrete operand builders (numpy; make_jaxpr abstracts them, calls use them)
+# ---------------------------------------------------------------------------
+
+
+def _concrete_arrays(n: int) -> Any:
+    from ..state.matrix import (
+        ATTR_SLOTS,
+        DEVICE_SLOTS,
+        PORT_WORDS,
+        PRIORITY_BUCKETS,
+        DeviceArrays,
+    )
+
+    return DeviceArrays(
+        totals=np.full((n, 3), 100.0, np.float32),
+        used=np.zeros((n, 3), np.float32),
+        eligible=np.ones((n,), bool),
+        attr_hash=np.zeros((n, ATTR_SLOTS), np.int32),
+        attr_num=np.zeros((n, ATTR_SLOTS), np.float32),
+        attr_ver=np.zeros((n, ATTR_SLOTS), np.float32),
+        class_id=np.zeros((n,), np.int32),
+        dev_total=np.zeros((n, DEVICE_SLOTS), np.int32),
+        dev_used=np.zeros((n, DEVICE_SLOTS), np.int32),
+        prio_used=np.zeros((n, PRIORITY_BUCKETS, 3), np.float32),
+        port_words=np.zeros((n, PORT_WORDS), np.uint32),
+        dyn_used=np.zeros((n,), np.int32),
+    )
+
+
+def _concrete_reqs(b: int) -> Any:
+    from ..ops.encode import (
+        MAX_AFFINITIES,
+        MAX_CONSTRAINTS,
+        MAX_DATACENTERS,
+        MAX_SPREAD_VALUES,
+        MAX_SPREADS,
+        MAX_STATIC_PORTS,
+        SchedRequest,
+    )
+    from ..state.matrix import DEVICE_SLOTS
+
+    f32, i32 = np.float32, np.int32
+    return SchedRequest(
+        ask=np.ones((b, 3), f32),
+        c_slot=np.full((b, MAX_CONSTRAINTS), -1, i32),
+        c_op=np.zeros((b, MAX_CONSTRAINTS), i32),
+        c_hash=np.zeros((b, MAX_CONSTRAINTS), i32),
+        c_num=np.zeros((b, MAX_CONSTRAINTS), f32),
+        dc_hash=np.full((b, MAX_DATACENTERS), -1, i32),
+        dev_ask=np.zeros((b, DEVICE_SLOTS), i32),
+        algorithm=np.zeros((b,), i32),
+        desired_count=np.ones((b,), f32),
+        a_slot=np.full((b, MAX_AFFINITIES), -1, i32),
+        a_op=np.zeros((b, MAX_AFFINITIES), i32),
+        a_hash=np.zeros((b, MAX_AFFINITIES), i32),
+        a_num=np.zeros((b, MAX_AFFINITIES), f32),
+        a_weight=np.zeros((b, MAX_AFFINITIES), f32),
+        s_slot=np.full((b, MAX_SPREADS), -1, i32),
+        s_weight=np.zeros((b, MAX_SPREADS), f32),
+        s_even=np.zeros((b, MAX_SPREADS), bool),
+        s_value_hash=np.zeros((b, MAX_SPREADS, MAX_SPREAD_VALUES), i32),
+        s_desired=np.zeros((b, MAX_SPREADS, MAX_SPREAD_VALUES), f32),
+        s_implicit=np.zeros((b, MAX_SPREADS), f32),
+        s_sum_weights=np.zeros((b,), f32),
+        preempt_bucket=np.full((b,), -1, i32),
+        distinct_hosts=np.zeros((b,), bool),
+        p_static=np.full((b, MAX_STATIC_PORTS), -1, i32),
+        p_dyn=np.zeros((b,), i32),
+    )
+
+
+def fused_operands(g: Grid) -> Tuple[Any, ...]:
+    """The 11-operand tuple shared by every fused_place_batch variant."""
+    from ..ops.encode import MAX_SPREAD_VALUES, MAX_SPREADS
+
+    n, b, k = g.nodes, g.batch, g.deltas
+    lane_mask = np.zeros((b,), bool)
+    lane_mask[: g.live] = True
+    return (
+        _concrete_arrays(n),
+        np.zeros((n, 3), np.float32),  # used
+        np.full((b, k), -1, np.int32),  # delta_rows (-1 = no delta)
+        np.zeros((b, k, 3), np.float32),  # delta_vals
+        np.zeros((b, n), np.int32),  # tg_counts
+        np.zeros((b, MAX_SPREADS, MAX_SPREAD_VALUES), np.float32),
+        np.zeros((b, n), bool),  # penalties
+        _concrete_reqs(b),
+        np.ones((b, 1), bool),  # class_eligs
+        np.ones((b, n), bool),  # host_masks
+        lane_mask,
+    )
+
+
+def scatter_operands(g: Grid) -> Tuple[Any, ...]:
+    """(device, idx, *row_data) for the dirty-row scatter; ``g.deltas``
+    is the (already pow2-padded) dirty-row count."""
+    arrays = _concrete_arrays(g.nodes)
+    k = g.deltas
+    idx = np.arange(k, dtype=np.int32) % g.nodes
+    row_data = tuple(np.asarray(f)[:k] for f in arrays)
+    return (arrays, idx) + row_data
+
+
+# ---------------------------------------------------------------------------
+# J105 sweeps — concrete call sequences whose compile cost is ratcheted
+# ---------------------------------------------------------------------------
+
+
+def _cache_size(entry: Callable[..., Any]) -> int:
+    size = getattr(entry, "_cache_size", None)
+    return int(size()) if callable(size) else 0
+
+
+def occupancy_sweep(entry: Callable[..., Any], c: DeviceContract) -> int:
+    """Call the entry at every lane-mask fill 1..batch (fresh operands
+    per call — donated buffers are consumed) and return how many NEW
+    compile-cache entries the sweep cost.  The contract: occupancy is a
+    runtime value, so ONE compile serves all fills."""
+    import jax
+
+    g = c.compile_grid
+    assert g is not None
+    before = _cache_size(entry)
+    for k in range(1, g.batch + 1):
+        gk = g._replace(live=k)
+        out = entry(*c.operands(gk), **c.static_kwargs(gk))
+        jax.block_until_ready(out)  # the compile must have really happened
+    return _cache_size(entry) - before
+
+
+def pow2_rows_sweep(entry: Callable[..., Any], c: DeviceContract) -> int:
+    """Scatter sweep: dirty-row counts 1..batch, pow2-padded the way
+    ``NodeMatrix._sync_locked`` pads them, so the distinct idx shapes —
+    and therefore compiles — stay logarithmic in the row count."""
+    import jax
+
+    g = c.compile_grid
+    assert g is not None
+    before = _cache_size(entry)
+    for k in range(1, g.batch + 1):
+        padded = 1 << (k - 1).bit_length()
+        gk = g._replace(deltas=padded)
+        out = entry(*c.operands(gk), **c.static_kwargs(gk))
+        jax.block_until_ready(out)
+    return _cache_size(entry) - before
+
+
+# ---------------------------------------------------------------------------
+# The table
+# ---------------------------------------------------------------------------
+
+# Trace grids: two node counts (prime-ish, colliding with no slot width,
+# batch, placement, or delta dimension) prove node-count independence;
+# the third point swaps the static Features bucket.  Kept moderate —
+# tracing cost is per-equation, not per-element.
+_N_A, _N_B = 97, 159
+
+
+def _fused_trace_grids() -> Tuple[Grid, ...]:
+    from ..ops.kernels import FULL_FEATURES, Features
+
+    narrow = Features(c_width=0, a_width=0, s_width=0, preempt=False, ports=False)
+    base = Grid(nodes=_N_A, batch=6, placements=3, deltas=5, live=6,
+                features=FULL_FEATURES)
+    return (base, base._replace(nodes=_N_B), base._replace(features=narrow))
+
+
+def _fused_compile_grid() -> Grid:
+    from ..ops.kernels import Features
+
+    narrow = Features(c_width=0, a_width=0, s_width=0, preempt=False, ports=False)
+    return Grid(nodes=32, batch=4, placements=2, deltas=4, live=4, features=narrow)
+
+
+def _fused_budget(g: Grid) -> int:
+    # One packed (B, P, FUSED_PACKED_WIDTH) f32 fetch: 32 B per
+    # placement-row per eval, whatever the node count.
+    from ..ops.kernels import FUSED_PACKED_WIDTH
+
+    return g.batch * g.placements * FUSED_PACKED_WIDTH * 4
+
+
+def table() -> Tuple[DeviceContract, ...]:
+    """The registered device entry points.  Built lazily (imports jax)."""
+    from ..ops import kernels
+    from ..parallel import sharding
+    from ..state import matrix
+
+    fused_kwargs = lambda g: {"n_placements": g.placements, "features": g.features}
+    trace_grids = _fused_trace_grids()
+    compile_grid = _fused_compile_grid()
+
+    def build_sharded(g: Grid) -> Callable[..., Any]:
+        # Deterministic 1-device (1, 1) mesh: collectives and the
+        # shard_map boundary are present in the trace regardless of the
+        # physical shard count, so the contract holds wherever it runs.
+        mesh = sharding.make_mesh(1, batch=1)
+        return sharding.sharded_fused_place_batch(mesh, g.placements)
+
+    scatter_grid = Grid(nodes=_N_A, batch=4, placements=1, deltas=4, live=4)
+    return (
+        DeviceContract(
+            name="fused_place_batch",
+            path="nomad_tpu/ops/kernels.py",
+            build=lambda g: kernels.fused_place_batch,
+            operands=fused_operands,
+            static_kwargs=fused_kwargs,
+            trace_grids=trace_grids,
+            out_budget=_fused_budget,
+            donated_args=(),  # the un-donated entry: tests/tools reuse inputs
+            compile_grid=compile_grid,
+        ),
+        DeviceContract(
+            name="fused_place_batch_live",
+            path="nomad_tpu/ops/kernels.py",
+            build=lambda g: kernels.fused_place_batch_live,
+            operands=fused_operands,
+            static_kwargs=fused_kwargs,
+            trace_grids=trace_grids,
+            out_budget=_fused_budget,
+            donated_args=tuple(range(2, 11)),  # per-dispatch lane operands
+            compile_grid=compile_grid,
+            sweep=occupancy_sweep,
+            max_compiles=1,  # occupancy is runtime data: ONE compile, all fills
+        ),
+        DeviceContract(
+            name="sharded_fused_place_batch",
+            path="nomad_tpu/parallel/sharding.py",
+            build=build_sharded,
+            operands=fused_operands,
+            static_kwargs=lambda g: {"features": g.features},
+            trace_grids=trace_grids,
+            out_budget=_fused_budget,
+            donated_args=(),  # matrix stays shared with in-flight dispatches
+        ),
+        DeviceContract(
+            name="make_row_scatter",
+            path="nomad_tpu/state/matrix.py",
+            build=lambda g: matrix.make_row_scatter(),
+            operands=scatter_operands,
+            static_kwargs=lambda g: {},
+            trace_grids=(scatter_grid, scatter_grid._replace(nodes=_N_B)),
+            out_budget=None,  # outputs ARE the device-resident matrix
+            node_axis_outputs_ok=True,
+            donated_args=(),  # in-flight dispatches still read the old snapshot
+            compile_grid=scatter_grid._replace(nodes=32),
+            sweep=pow2_rows_sweep,
+            max_compiles=3,  # pow2 buckets of 1..4 dirty rows: {1, 2, 4}
+        ),
+    )
+
+
+def get(name: str) -> DeviceContract:
+    for c in table():
+        if c.name == name:
+            return c
+    raise KeyError(name)

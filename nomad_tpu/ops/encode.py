@@ -14,7 +14,12 @@ from __future__ import annotations
 import math
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+# Compile-time attribute recorder: maps an attribute name to its slot
+# (None = unregistered).  The encoder threads one through constraint and
+# affinity encoding so computed-class keys record what they depend on.
+AttrRecorder = Callable[[str], Optional[int]]
 
 import numpy as np
 
@@ -506,7 +511,7 @@ class RequestEncoder:
         return EscapedConstraint(constraint=con, unique=unique)
 
     def _encode_constraint(self, con: Constraint, emit, escaped,
-                           reg_attr=None) -> bool:
+                           reg_attr: Optional[AttrRecorder] = None) -> bool:
         if con.operand in (Op.DISTINCT_HOSTS.value, Op.DISTINCT_PROPERTY.value):
             # Handled by dedicated host-side iterators (feasible.go:505,604).
             escaped.append(self._escape(con))
@@ -520,7 +525,8 @@ class RequestEncoder:
         return emit(slot, op, h, num)
 
     def _encode_predicate(
-        self, l_target: str, operand: str, r_target: str, reg_attr=None
+        self, l_target: str, operand: str, r_target: str,
+        reg_attr: Optional[AttrRecorder] = None,
     ) -> Optional[Tuple[int, int, int, float]]:
         """Encode one predicate as (slot, op, hash, num); None = escape.
         ``reg_attr`` (compile-time recorder) defaults to the raw registry."""

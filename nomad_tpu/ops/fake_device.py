@@ -183,8 +183,9 @@ def port_mask(arrays, req: SchedRequest) -> np.ndarray:
     return (~conflict) & dyn_ok
 
 
-def feasibility_mask(arrays, req: SchedRequest, class_elig=None,
-                     host_mask=None) -> np.ndarray:
+def feasibility_mask(arrays, req: SchedRequest,
+                     class_elig: Optional[np.ndarray] = None,
+                     host_mask: Optional[np.ndarray] = None) -> np.ndarray:
     mask = arrays.eligible.copy()
     mask &= datacenter_mask(arrays, req)
     mask &= constraint_mask(arrays, req)
@@ -787,7 +788,8 @@ def sharded_fused_place_batch(arrays, used, delta_rows, delta_vals,
                               tg_counts, spread_counts, penalties, reqs,
                               class_eligs, host_masks, lane_mask,
                               n_shards: int, n_placements: int,
-                              live_counts=None) -> np.ndarray:
+                              live_counts: Optional[List[int]] = None,
+                              ) -> np.ndarray:
     """Twin of parallel.sharding.sharded_fused_place_batch for host-only CI.
 
     The sharded kernel's hierarchical top-k election (per-shard stable

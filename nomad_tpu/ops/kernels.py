@@ -23,7 +23,7 @@ golden tests against the scalar oracle in structs.funcs):
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -262,7 +262,9 @@ def port_mask(arrays, req: SchedRequest, enabled: bool = True) -> jnp.ndarray:
     return (~conflict) & dyn_ok
 
 
-def feasibility_mask(arrays, req: SchedRequest, class_elig=None, host_mask=None,
+def feasibility_mask(arrays, req: SchedRequest,
+                     class_elig: Optional[jnp.ndarray] = None,
+                     host_mask: Optional[jnp.ndarray] = None,
                      features: Features = FULL_FEATURES):
     """(N,) bool — eligible ∧ dc ∧ constraints ∧ devices ∧ escaped checks.
 

@@ -245,23 +245,37 @@ class DeviceArrays(NamedTuple):
 _SCATTER_FN = None
 
 
+def make_row_scatter():
+    """Build the jitted multi-field dirty-row scatter.
+
+    ``scatter(device, idx, *row_data) -> DeviceArrays`` writes rows
+    ``idx`` of every matrix field in ONE dispatch; numpy operands
+    transfer as part of that dispatch — the cheap path through a
+    high-latency tunnel.  This factory is the registered device entry
+    point for the scatter in ``lint/contracts.py`` (the jaxpr-level
+    contract gate traces and sweeps it), so keep its signature stable;
+    ``_scatter_rows`` below is the lazy process-wide instance the sync
+    path actually calls.
+    """
+    import jax
+
+    def scat(d, i, *vals):
+        return DeviceArrays(
+            **{
+                f: getattr(d, f).at[i].set(v)
+                for f, v in zip(DeviceArrays._fields, vals)
+            }
+        )
+
+    return jax.jit(scat)
+
+
 def _scatter_rows(device: "DeviceArrays", idx, *row_data) -> "DeviceArrays":
     """Jitted multi-field row scatter (lazy so importing nomad_tpu doesn't
-    initialize a jax backend). Numpy operands transfer as part of the one
-    dispatch — the cheap path through a high-latency tunnel."""
+    initialize a jax backend)."""
     global _SCATTER_FN
     if _SCATTER_FN is None:
-        import jax
-
-        def scat(d, i, *vals):
-            return DeviceArrays(
-                **{
-                    f: getattr(d, f).at[i].set(v)
-                    for f, v in zip(DeviceArrays._fields, vals)
-                }
-            )
-
-        _SCATTER_FN = jax.jit(scat)
+        _SCATTER_FN = make_row_scatter()
     return _SCATTER_FN(device, idx, *row_data)
 
 

@@ -501,6 +501,39 @@ class TestJ005NodeAxisFetch:
         })
         assert "J005" not in _rules(fs), fs
 
+    def test_one_hop_helper_evasion_is_a_documented_miss(self):
+        # KNOWN EVASION, kept as a pinned expected-miss: J005 tracks
+        # node-axis leaves through LOCAL variables only, so threading the
+        # fetch through one helper function defeats it — `_snapshot` is
+        # an opaque call, and its np.asarray happens in a function that
+        # never touches the fused entry points (exactly the shape
+        # test_node_fetch_off_the_fused_path_is_not_j005 exempts).
+        # Closing this lexically would mean whole-program dataflow; the
+        # semantic layer covers it instead: the same leak traced to a
+        # jaxpr is an N-shaped value crossing the mesh boundary, which
+        # fires J103 whatever the Python call graph looked like
+        # (tests/test_jaxprpass.py::test_j103_catches_the_j005_helper_evasion).
+        # If this assertion ever flips, J005 grew dataflow tracking —
+        # celebrate, then delete the J103 cross-reference above.
+        fs = jaxpass.analyze_sources({
+            "nomad_tpu/scheduler/coalescer.py": textwrap.dedent(
+                """
+                def _snapshot(x):
+                    return np.asarray(x)
+
+                def evades(self, arrays, dr, dv, reqs, lm):
+                    packed = self._sharded_fused_fn(
+                        arrays, arrays.used, dr, dv, reqs, lm,
+                    )
+                    return packed, _snapshot(arrays.used)
+                """
+            )
+        })
+        assert "J005" not in _rules(fs), (
+            "J005 now sees through helper calls — update this fixture "
+            "and the STATIC_ANALYSIS.md evasion note"
+        )
+
 
 # ----------------------------------------------------------------------
 # C001–C004 — chaos seams

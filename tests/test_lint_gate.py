@@ -1,8 +1,16 @@
 """The lint gate: tier-1 runs the full analyzer in-process and fails on
 any non-baselined finding — `python -m nomad_tpu.lint` as a pytest node,
-so the gate rides the existing test command with no new CI surface."""
+so the gate rides the existing test command with no new CI surface.
+
+(The jaxpr-level semantic gate is its own tier-1 node next door:
+tests/test_jaxprpass.py::test_live_tree_contracts_clean_against_baseline
+— it needs a JAX backend, this one deliberately does not.)"""
 
 from __future__ import annotations
+
+import json
+
+import pytest
 
 from nomad_tpu.lint import load_baseline, repo_root, run_all, split_baselined
 
@@ -25,3 +33,52 @@ def test_every_baseline_entry_has_a_justification():
     baseline = load_baseline()
     missing = [e for e in baseline.entries if not e.get("why")]
     assert missing == [], missing
+
+
+# ----------------------------------------------------------------------
+# Baseline hygiene: the loader is the gate, not convention.
+# ----------------------------------------------------------------------
+
+
+def _write_baseline(tmp_path, entries):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"exemptions": entries}))
+    return str(p)
+
+
+def _entry(rule="L003", path="a.py", symbol="f", why="because"):
+    return {"rule": rule, "path": path, "symbol": symbol, "why": why}
+
+
+def test_baseline_loader_rejects_duplicate_keys(tmp_path):
+    # Duplicates used to be silently tolerated with first-match-wins,
+    # which made one of the two `why` texts dead — and which `why` won
+    # depended on file order.  Now it's a load error.
+    p = _write_baseline(
+        tmp_path, [_entry(why="the real reason"), _entry(why="a stale copy")]
+    )
+    with pytest.raises(ValueError, match="duplicate"):
+        load_baseline(p)
+
+
+def test_baseline_loader_rejects_unsorted_entries(tmp_path):
+    p = _write_baseline(
+        tmp_path, [_entry(symbol="zeta"), _entry(symbol="alpha")]
+    )
+    with pytest.raises(ValueError, match="sorted"):
+        load_baseline(p)
+
+
+def test_baseline_loader_accepts_sorted_unique_entries(tmp_path):
+    p = _write_baseline(
+        tmp_path, [_entry(symbol="alpha"), _entry(symbol="zeta")]
+    )
+    assert len(load_baseline(p).entries) == 2
+
+
+def test_committed_baseline_is_canonical():
+    # Loading the committed file exercises both hygiene checks; an
+    # unsorted or duplicated committed baseline can no longer ship.
+    baseline = load_baseline()
+    keys = [(e["rule"], e["path"], e["symbol"]) for e in baseline.entries]
+    assert keys == sorted(keys) and len(keys) == len(set(keys))

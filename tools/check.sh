@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# One-shot local gate: everything a PR must survive, in dependency order,
+# with a per-stage summary at the end.  Runs ALL stages even when an
+# early one fails (you want the whole damage report, not the first
+# casualty); exits nonzero if ANY stage failed.
+#
+#   stage 1  lint (ast)     python -m nomad_tpu.lint          — syntactic rules
+#   stage 2  lint (jaxpr)   python -m nomad_tpu.lint --jaxpr  — semantic device contracts
+#   stage 3  typecheck      tools/typecheck.sh                — mypy (skips if not installed)
+#   stage 4  tier-1         the ROADMAP.md pytest command     — the real test gate
+#
+# Usage: tools/check.sh [--fast]   (--fast skips stage 4)
+set -u
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+names=()
+rcs=()
+
+stage() {
+    local name="$1"
+    shift
+    echo
+    echo "=== ${name} ==="
+    "$@"
+    local rc=$?
+    names+=("$name")
+    rcs+=("$rc")
+    return 0
+}
+
+stage "lint (ast)" env JAX_PLATFORMS=cpu python -m nomad_tpu.lint
+stage "lint (jaxpr)" env JAX_PLATFORMS=cpu python -m nomad_tpu.lint --jaxpr
+stage "typecheck" bash tools/typecheck.sh
+if [ "$FAST" -eq 0 ]; then
+    # Tier-1, verbatim from ROADMAP.md (minus the log tee — this is the
+    # local loop, not the driver).
+    stage "tier-1" env JAX_PLATFORMS=cpu timeout -k 10 870 \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly
+fi
+
+echo
+echo "=== summary ==="
+fail=0
+for i in "${!names[@]}"; do
+    if [ "${rcs[$i]}" -eq 0 ]; then
+        echo "  PASS  ${names[$i]}"
+    else
+        echo "  FAIL  ${names[$i]} (rc=${rcs[$i]})"
+        fail=1
+    fi
+done
+exit "$fail"

@@ -1,7 +1,11 @@
 #!/usr/bin/env bash
 # Typing gate: mypy (non-strict, --check-untyped-defs) over the
 # declarative layers — nomad_tpu/structs/ (wire/serde contracts) and
-# nomad_tpu/lint/ (the analyzer itself).  Config: mypy.ini.
+# nomad_tpu/lint/ (the analyzer itself) — and the device hot path —
+# nomad_tpu/ops/ (kernels, request encoding, numpy twin) and
+# nomad_tpu/parallel/ (mesh sharding), where a drifted NamedTuple field
+# or Optional default becomes a silent recompile or a wrong-dtype
+# transfer.  Config: mypy.ini.
 #
 # Exits 0 with a notice when mypy is not installed (the CI image may not
 # ship it; the gate must not invent a dependency) — run
@@ -15,4 +19,5 @@ if ! python -m mypy --version >/dev/null 2>&1; then
     exit 0
 fi
 
-exec python -m mypy --config-file mypy.ini nomad_tpu/structs/ nomad_tpu/lint/
+exec python -m mypy --config-file mypy.ini \
+    nomad_tpu/structs/ nomad_tpu/lint/ nomad_tpu/ops/ nomad_tpu/parallel/
