@@ -34,6 +34,18 @@ Seam catalog (ctx keys each seam passes):
 - ``admission.gate``  — namespace                  (``error`` = spurious
   429: a submission with bucket capacity is rejected anyway —
   exercises the client's Retry-After path)
+- ``device.wedge``    — lanes                      (device→host fetch
+  never returns: the resolver's watchdog abandons it, the breaker
+  trips, lanes fail with ``DeviceWedgedError``)
+- ``device.slow``     — lanes                      (fetch returns past
+  the deadline but inside the wedge bound — late but usable; feeds
+  the breaker's slow-ratio trip)
+- ``shard.loss``      — shards, lanes              (a whole matrix home
+  shard dies mid-dispatch; ``lost`` evacuates it — survivors
+  re-lay-out, in-flight tickets invalidate via the remap window)
+- ``shard.partition`` — shards, lanes              (``dark`` marks one
+  home shard's nodes ineligible mid-dispatch — healable partition,
+  distinct from the permanent ``shard.loss`` evacuation)
 
 Fault kinds each seam understands (others are ignored there):
 
@@ -50,7 +62,15 @@ Fault kinds each seam understands (others are ignored there):
 - ``skip``    — ``client.heartbeat`` silently misses a beat; at
   ``driver.stop`` the stop request is swallowed
 - ``hang``    — driver seams block ``duration`` seconds (wedged syscall)
-- ``wedge``   — ``driver.wait`` reports "still running" forever
+- ``wedge``   — ``driver.wait`` reports "still running" forever; at
+  ``device.wedge`` the device→host fetch blocks past every watchdog
+  bound (``duration`` caps the synthetic hold when > 0)
+- ``slow``    — ``device.slow`` holds the fetch into the slow band
+  (past the deadline, inside the wedge bound)
+- ``lost``    — ``shard.loss`` kills a matrix home shard; the
+  coalescer evacuates it across the survivors
+- ``dark``    — ``shard.partition`` marks a home shard's nodes
+  ineligible (authoritative-state partition, healable)
 - ``exit127`` — ``driver.start`` runs a command that exits 127
   (missing-binary analog)
 """

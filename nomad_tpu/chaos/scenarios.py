@@ -107,9 +107,16 @@ def _small_job(i: int = 0):
 
 
 def _evals_settled(server) -> bool:
-    """Quiescence: nothing pending/checked-out in the broker."""
+    """Quiescence: nothing ready/pending/checked-out in the broker.
+    ``ready_count`` matters: right after a submit burst the evals sit
+    *ready* (not yet dequeued), so pending+unacked alone reads settled
+    during the window before any worker picks them up."""
     broker = server.eval_broker
-    return broker.pending_count() == 0 and broker.unacked_count() == 0
+    return (
+        broker.ready_count() == 0
+        and broker.pending_count() == 0
+        and broker.unacked_count() == 0
+    )
 
 
 def _fault_rows(inj) -> List[tuple]:
@@ -653,6 +660,391 @@ def breach_while_leader_killed(seed: int, workdir: str) -> Dict:
     return report
 
 
+# ----------------------------------------------------------------------
+# Device fault domain scenarios (ISSUE 20): watchdog, breaker, evacuation
+# ----------------------------------------------------------------------
+
+class _pinned_env:
+    """Set env knobs for the scenario's lifetime, restoring on exit —
+    breaker config is read from the env at coalescer construction, so
+    the knobs must be pinned before the Server/DeviceCoalescer exists."""
+
+    def __init__(self, **kv):
+        self._kv = {k: str(v) for k, v in kv.items()}
+        self._saved: Dict[str, object] = {}
+
+    def __enter__(self):
+        for k, v in self._kv.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False
+
+
+def _coalescer_inputs(m, job):
+    """Compiled placement-request operands for one job (the
+    tests/test_pipeline.py idiom)."""
+    import numpy as np
+
+    from ..ops.encode import RequestEncoder
+    from ..scheduler.coalescer import MAX_DELTA_ROWS
+
+    enc = RequestEncoder(m)
+    compiled = enc.compile(job, job.task_groups[0])
+    n = m.capacity
+    return dict(
+        request=compiled.request,
+        delta_rows=np.full((MAX_DELTA_ROWS,), -1, np.int32),
+        delta_vals=np.zeros((MAX_DELTA_ROWS, 3), np.float32),
+        tg_count=np.zeros((n,), np.int32),
+        spread_counts=np.zeros_like(compiled.request.s_desired),
+        penalty=np.zeros((n,), bool),
+        class_elig=np.ones((2,), bool),
+        host_mask=np.ones((n,), bool),
+    )
+
+
+def wedged_dispatch_recovers(
+    seed: int, workdir: str, crowd: int = 24
+) -> Dict:
+    """One device→host fetch wedges at full pipeline depth: the watchdog
+    must classify and abandon it inside its bound (no future ever
+    hangs), the breaker must trip, the wedged evals must redeliver
+    through the worker's nack path and land via the degraded host twin,
+    the breaker must re-close through its half-open canary once the
+    fault schedule is spent, and live throughput must recover to ≥50%
+    of the healthy baseline within the scenario window (degraded bursts
+    and the post-re-close burst both count)."""
+    from .. import mock
+    from ..server import Server, ServerConfig
+
+    report: Dict = {"name": "wedged_dispatch_recovers", "seed": seed}
+    violations: List[str] = []
+    env = _pinned_env(
+        NOMAD_TPU_FAKE_DEVICE="1",
+        NOMAD_TPU_DEVICE_DEADLINE_MS="150",
+        NOMAD_TPU_DEVICE_COLD_SCALE="1",
+        NOMAD_TPU_DEVICE_PROBATION="0.3",
+        NOMAD_TPU_DEVICE_COOLDOWN="0.05",
+    )
+    with env:
+        srv = Server(ServerConfig(
+            num_workers=2,
+            heartbeat_min_ttl=3600.0, heartbeat_max_ttl=7200.0,
+            eval_nack_timeout=5.0, pipeline_depth=8,
+            slo_enabled=False,
+        ))
+        srv.start()
+        try:
+            for _ in range(4):
+                srv.register_node(mock.node())
+            coal = srv.coalescer
+            brk = coal.breaker
+
+            def burst(count, offset):
+                """Submit→queues-empty wall time for one burst (the
+                submission loop is part of the measured phase — both
+                phases pay it identically).  The drain poll is much
+                tighter than elsewhere: a burst this small settles in
+                single-digit milliseconds, so a 10 ms poll would *be*
+                the measurement."""
+                t0 = time.time()
+                for i in range(count):
+                    srv.submit_job(_small_job(offset + i))
+                ok = _wait(
+                    lambda: _evals_settled(srv), timeout=60, every=0.0005
+                )
+                return count / max(time.time() - t0, 1e-6), ok
+
+            def best_burst(offsets):
+                """Max rate over repeated measurement bursts.  A burst
+                of `crowd` small jobs drains in single-digit
+                milliseconds — one scheduler hiccup dominates the rate —
+                so the measured bursts are 4× the crowd (amortize) and a
+                hiccup can only *lower* a measurement, so best-of-N
+                estimates capability."""
+                best = 0.0
+                all_ok = True
+                for off in offsets:
+                    rate, ok = burst(4 * crowd, off)
+                    best = max(best, rate)
+                    all_ok = all_ok and ok
+                return best, all_ok
+
+            # Warm-up (first-eval jit/encoder compile), then the healthy
+            # baseline bursts.
+            _, ok = burst(5, 0)
+            if not ok:
+                violations.append("warm-up burst never drained")
+            pre_rate, ok = best_burst((1000, 1200, 1400))
+            if not ok:
+                violations.append("baseline burst never drained")
+            report["pre_rate"] = round(pre_rate, 1)
+
+            # -- the wedge: one fetch blows through the watchdog -------
+            schedule = [FaultSpec("device.wedge", "wedge", count=1)]
+            with injected(seed, schedule) as inj:
+                for i in range(crowd):
+                    srv.submit_job(_small_job(100 + i))
+                tripped = _wait(
+                    lambda: brk.brief()["breaker"] != "closed",
+                    timeout=15,
+                )
+                drained = _wait(
+                    lambda: _evals_settled(srv), timeout=60
+                )
+                report["faults"] = _fault_rows(inj)
+
+            # -- degraded-path throughput: placements keep flowing -----
+            # (the breaker re-closes through its half-open canary
+            # somewhere inside this burst once probation elapses —
+            # both regimes count toward the ≥50% floor).
+            post_rate, ok = best_burst((2000, 2200, 2400))
+            if not ok:
+                violations.append("degraded burst never drained")
+
+            brief = brk.brief()
+            report.update({
+                "tripped": tripped,
+                "wedged_dispatches": coal.wedged_dispatches,
+                "degraded_dispatches": brief["degraded_dispatches"],
+                "trips": brief["trips"],
+                "crowd_drained": drained,
+                "post_rate": round(post_rate, 1),
+            })
+            if not any(k == "wedge" for _, k, _ in report["faults"]):
+                violations.append("wedge fault never fired")
+            if not tripped:
+                violations.append("breaker never left closed")
+            if coal.wedged_dispatches < 1:
+                violations.append("no dispatch classified wedged")
+            if brief["degraded_dispatches"] < 1:
+                violations.append("no dispatch took the degraded path")
+            if not drained:
+                violations.append(
+                    "wedged crowd never drained — a future hung past "
+                    "the watchdog or redelivery stalled"
+                )
+            # Recovery: the half-open canary needs live dispatches to
+            # carry its verdict — trickle until the breaker re-closes.
+            deadline = time.time() + 15
+            i = 0
+            while (
+                brk.brief()["breaker"] != "closed"
+                and time.time() < deadline
+            ):
+                srv.submit_job(_small_job(500 + i))
+                i += 1
+                _wait(lambda: _evals_settled(srv), timeout=10)
+                time.sleep(0.05)
+            recovered = brk.brief()["breaker"] == "closed"
+            report["recovered"] = recovered
+            if not recovered:
+                violations.append(
+                    "breaker never re-closed once the schedule was spent"
+                )
+            # The recovery floor spans the whole post-wedge window: the
+            # degraded bursts above AND a post-re-close burst — "live
+            # throughput recovers to ≥50% of healthy within the
+            # scenario window", not "the host twin matches the device".
+            rec_rate, ok = best_burst((3000, 3200))
+            if not ok:
+                violations.append("post-recovery burst never drained")
+            best_post = max(post_rate, rec_rate)
+            ratio = best_post / pre_rate if pre_rate > 0 else None
+            report["recovered_rate"] = round(rec_rate, 1)
+            report["throughput_ratio"] = (
+                round(ratio, 3) if ratio is not None else None
+            )
+            if ratio is not None and ratio < 0.5:
+                violations.append(
+                    f"throughput never recovered to ≥50% of healthy: "
+                    f"best post-wedge {best_post:.1f}/s vs "
+                    f"{pre_rate:.1f}/s healthy"
+                )
+            violations += check_store(srv)
+            report["violations"] = violations
+        finally:
+            srv.shutdown()
+    return report
+
+
+def device_slow_flapping(
+    seed: int, workdir: str, dispatches: int = 60
+) -> Dict:
+    """A flapping ``device.slow`` seam (p=0.5) drives the breaker's
+    slow-ratio trip back and forth through open/half-open/closed; the
+    flip budget must bound the oscillation and every placement must
+    still complete."""
+    from .. import mock
+    from ..scheduler.coalescer import DeviceCoalescer
+    from ..state.matrix import NodeMatrix
+
+    report: Dict = {"name": "device_slow_flapping", "seed": seed}
+    violations: List[str] = []
+    env = _pinned_env(
+        NOMAD_TPU_FAKE_DEVICE="1",
+        NOMAD_TPU_DEVICE_DEADLINE_MS="40",
+        NOMAD_TPU_DEVICE_COLD_SCALE="1",
+        NOMAD_TPU_DEVICE_MIN_SAMPLES="4",
+        NOMAD_TPU_DEVICE_WINDOW="30",
+        NOMAD_TPU_DEVICE_PROBATION="0.05",
+        NOMAD_TPU_DEVICE_COOLDOWN="0.02",
+        NOMAD_TPU_DEVICE_MAX_FLIPS="4",
+        NOMAD_TPU_DEVICE_FLIP_WINDOW="60",
+    )
+    with env:
+        m = NodeMatrix(capacity=16)
+        for _ in range(8):
+            m.upsert_node(mock.node())
+        coal = DeviceCoalescer(
+            m, max_lanes=1, linger_s=0.0, pipeline_depth=1
+        )
+        coal.start()
+        try:
+            inputs = _coalescer_inputs(m, _small_job())
+            schedule = [FaultSpec("device.slow", "slow", p=0.5)]
+            placed = 0
+            with injected(seed, schedule) as inj:
+                for _ in range(dispatches):
+                    out = coal.place(**inputs)
+                    if out is not None:
+                        placed += 1
+                report["faults"] = _fault_rows(inj)
+        finally:
+            coal.stop()
+        brk = coal.breaker
+        brief = brk.brief()
+        report.update({
+            "placed": placed,
+            "slow_recorded": brief["slow"],
+            "trips": brief["trips"],
+            "flips": brk.flips_total,
+            "flips_suppressed": brk.flips_suppressed,
+            "flip_budget": brk.cfg.max_flips,
+            "final_state": brief["breaker"],
+        })
+        if placed != dispatches:
+            violations.append(
+                f"only {placed}/{dispatches} placements completed"
+            )
+        if not any(k == "slow" for _, k, _ in report["faults"]):
+            violations.append("slow fault never fired")
+        if brief["slow"] < 1:
+            violations.append("no fetch classified slow")
+        if brk.flips_total > brk.cfg.max_flips:
+            violations.append(
+                f"flip budget breached: {brk.flips_total} flips > "
+                f"budget {brk.cfg.max_flips}"
+            )
+        report["violations"] = violations
+    return report
+
+
+def shard_loss_evacuation(seed: int, workdir: str) -> Dict:
+    """Lose a whole matrix home shard mid-dispatch: the matrix must
+    evacuate it (re-lay-out across the survivors), the post-evacuation
+    layout must be bit-identical to inserting the same nodes in old-row
+    order into a from-scratch survivor matrix (the PARITY.md proof),
+    the in-flight placement must still complete against the re-homed
+    layout, and ``heal`` must restore the original shard count with
+    store invariants green."""
+    from .. import mock
+    from ..scheduler.coalescer import DeviceCoalescer
+    from ..server import Server, ServerConfig
+    from ..state.matrix import NodeMatrix
+
+    report: Dict = {"name": "shard_loss_evacuation", "seed": seed}
+    violations: List[str] = []
+    with _pinned_env(NOMAD_TPU_FAKE_DEVICE="1"):
+        srv = Server(ServerConfig(
+            num_workers=2,
+            heartbeat_min_ttl=3600.0, heartbeat_max_ttl=7200.0,
+        ))
+        srv.start()
+        try:
+            m = srv.store.matrix
+            m.set_shard_count(4)
+            nodes = [mock.node() for _ in range(12)]
+            for n in nodes:
+                srv.register_node(n)
+            pre_counts = m.shard_row_counts()
+            # Old-row insertion order: what the evacuation replay (and
+            # the from-scratch parity twin below) both iterate.
+            order = [m.node_of[r] for r in sorted(m.node_of)]
+            by_id = {n.id: n for n in nodes}
+
+            coal = DeviceCoalescer(
+                m, max_lanes=2, linger_s=0.0, pipeline_depth=1
+            )
+            coal.start()
+            try:
+                schedule = [FaultSpec("shard.loss", "lost", count=1)]
+                with injected(seed, schedule) as inj:
+                    out = coal.place(**_coalescer_inputs(m, mock.job()))
+                    report["faults"] = _fault_rows(inj)
+                report.update({
+                    "pre_shards": 4,
+                    "pre_counts": pre_counts,
+                    "post_shards": int(m.shard_count),
+                    "post_counts": m.shard_row_counts(),
+                    "evacuations": coal.shard_evacuations,
+                    "placed_row": int(out.rows[0]),
+                })
+                if not any(
+                    k == "lost" for _, k, _ in report["faults"]
+                ):
+                    violations.append("loss fault never fired")
+                if int(m.shard_count) != 3:
+                    violations.append(
+                        f"expected 3 survivor shards, got {m.shard_count}"
+                    )
+                if coal.shard_evacuations != 1:
+                    violations.append("evacuation counter did not move")
+                if out.rows[0] < 0:
+                    violations.append(
+                        "in-flight placement failed after evacuation"
+                    )
+                # Parity: a from-scratch 3-shard matrix fed the same
+                # nodes in old-row order must assign identical rows.
+                twin = NodeMatrix(capacity=m.capacity)
+                twin.set_shard_count(int(m.shard_count))
+                for nid in order:
+                    twin.upsert_node(by_id[nid])
+                mismatches = [
+                    nid for nid in order
+                    if twin.row_of[nid] != m.row_of[nid]
+                ]
+                report["parity_mismatches"] = len(mismatches)
+                if mismatches:
+                    violations.append(
+                        f"evacuated layout diverges from from-scratch "
+                        f"survivor layout for {len(mismatches)} node(s)"
+                    )
+                # Heal: full re-layout back to the original partition.
+                restored = coal.heal_shard_evacuations()
+                report["restored_shards"] = restored
+                if restored != 4 or int(m.shard_count) != 4:
+                    violations.append("heal did not restore shard count")
+                out2 = coal.place(**_coalescer_inputs(m, mock.job()))
+                if out2.rows[0] < 0:
+                    violations.append("post-heal placement failed")
+            finally:
+                coal.stop()
+            violations += check_store(srv)
+            report["violations"] = violations
+        finally:
+            srv.shutdown()
+    return report
+
+
 SCENARIOS: Dict[str, Callable[..., Dict]] = {
     "leader_kill_mid_apply": leader_kill_mid_apply,
     "wal_truncation_sweep": wal_truncation_sweep,
@@ -660,4 +1052,7 @@ SCENARIOS: Dict[str, Callable[..., Dict]] = {
     "wedged_driver_during_drain": wedged_driver_during_drain,
     "flash_crowd_flapping_partition": flash_crowd_flapping_partition,
     "breach_while_leader_killed": breach_while_leader_killed,
+    "wedged_dispatch_recovers": wedged_dispatch_recovers,
+    "device_slow_flapping": device_slow_flapping,
+    "shard_loss_evacuation": shard_loss_evacuation,
 }

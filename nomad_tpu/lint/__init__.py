@@ -15,14 +15,17 @@ Three AST passes over the production tree, one runtime sanitizer:
 * **chaos seams** (:mod:`.chaospass`, rules ``C001``–``C004``) — the
   CHAOS.md seam catalog and retry surface cross-checked against the
   injector call sites and the tests that exercise them.
-* **observability** (:mod:`.obspass`, rules ``O001``–``O003``) — every
+* **observability** (:mod:`.obspass`, rules ``O001``–``O004``) — every
   injector call site must emit a trace event on the same path, so chaos
   faults are visible in flight-recorder dumps; every ``SLOSpec``'s
   literal objective must resolve to a metric the code actually
-  registers, so a renamed timer can't silently disarm an SLO; and every
+  registers, so a renamed timer can't silently disarm an SLO; every
   overload-actuator decision site (``set_gate_level``/``set_shedding``)
   must emit a trace event AND increment a ``nomad.*`` counter, so
-  control-loop flips stay auditable against the 429s/sheds they cause.
+  control-loop flips stay auditable against the 429s/sheds they cause;
+  and every device-breaker transition site (``_apply_transition``,
+  ``obs/breaker.py``) must do the same, so device↔degraded-path flips
+  stay auditable against the latency they cause.
 * **TSan-lite** (:mod:`.tsan`) — the runtime half: lockset-checked
   shared-state wrappers enabled under the seeded chaos scenarios.
 * **jaxpr contracts** (:mod:`.jaxprpass` + :mod:`.contracts`, rules

@@ -1,4 +1,4 @@
-"""Observability pass (rules O001–O003).
+"""Observability pass (rules O001–O004).
 
 The flight recorder is only as good as its coverage: a chaos seam that
 fires without leaving a trace event is invisible in the post-mortem
@@ -35,6 +35,16 @@ emit a trace event on the same path** — and this pass enforces it:
   counter can't be correlated with the 429s/deferrals it caused, and
   "why did throughput halve at 14:03" becomes unanswerable.
   :func:`analyze_actuators` is the per-module fixture API.
+
+* **O004 silent breaker transition** — a call site of the device
+  breaker's state mutator (``_apply_transition(...)``,
+  ``obs/breaker.py``) whose enclosing function does not BOTH emit a
+  trace event and increment a literal ``nomad.*`` counter.  Same
+  argument as O003 for the device fault domain: a breaker that flips
+  between the device path and the degraded host path without a trace
+  event and a counter makes "why did placement latency triple at
+  14:03" unanswerable.  :func:`analyze_breaker_transitions` is the
+  per-module fixture API.
 
 Shares the seam-site discovery with :mod:`.chaospass` (same
 ``INJECT_FUNC_NAMES``, same tree walk) so the two passes can't drift
@@ -333,6 +343,77 @@ def analyze_actuators(rel: str, src: str) -> List[Finding]:
     return findings
 
 
+# -- O004: breaker state transitions must trace + count -----------------
+
+# The device-breaker mutation surface: _apply_transition is the only
+# place the breaker's state actually moves (obs/breaker.py); every scope
+# calling it owns the trace event + counter emission.
+BREAKER_CALL_NAMES = frozenset({"_apply_transition"})
+
+
+def _breaker_calls(body: ast.AST) -> List[Tuple[str, int]]:
+    """(mutator name, line) for calls directly inside ``body`` (nested
+    defs excluded — same scoping discipline as the actuator walk)."""
+    out: List[Tuple[str, int]] = []
+    for child in ast.iter_child_nodes(body):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(child, ast.Call):
+            fname = _call_name(child)
+            if fname in BREAKER_CALL_NAMES:
+                out.append((fname, child.lineno))
+        out.extend(_breaker_calls(child))
+    return out
+
+
+def analyze_breaker_transitions(rel: str, src: str) -> List[Finding]:
+    """Pure per-module O004 check — the test fixture API."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+
+    funcs: List[Tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                funcs.append((qual, child))
+                visit(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}.{child.name}" if prefix else child.name)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+
+    findings: List[Finding] = []
+    for qual, scope in [("<module>", tree)] + funcs:
+        calls = _breaker_calls(scope)
+        if not calls:
+            continue
+        # The mutator's own definition is not a call site of itself.
+        if qual.endswith("_apply_transition"):
+            continue
+        missing = []
+        if not _emits_trace(scope):
+            missing.append("a trace event")
+        if not _incrs_registered_counter(scope):
+            missing.append('a literal `nomad.*` counter incr')
+        if not missing:
+            continue
+        for fname, line in calls:
+            findings.append(Finding(
+                "O004", rel, line, qual,
+                f"breaker transition `{fname}` moves here but `{qual}` "
+                f"never emits {' or '.join(missing)} — the device path "
+                f"flipped (device ↔ degraded host twin) with no way to "
+                f"line it up with the latency it caused",
+            ))
+    return findings
+
+
 def _walk_sources(root: str):
     pkg = os.path.join(root, "nomad_tpu")
     for dirpath, dirnames, filenames in os.walk(pkg):
@@ -360,5 +441,6 @@ def run(root: str) -> List[Finding]:
         if not rel.endswith(_SKIP_FILES):
             findings.extend(analyze_module(rel, src))
             findings.extend(analyze_actuators(rel, src))
+            findings.extend(analyze_breaker_transitions(rel, src))
         findings.extend(analyze_slo_objectives(rel, src, registered))
     return findings

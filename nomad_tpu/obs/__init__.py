@@ -11,8 +11,28 @@ dashboard (:mod:`.top`).  See OBSERVABILITY.md.
 The loop is closed by :class:`~.controller.OverloadController`
 (``GET /v1/overload``): pressure + burn rates drive admission gating,
 priority shedding, and report the DRR dequeue fairness stats.
+
+The device fault domain lives in :mod:`.breaker`: the coalescer's
+fetch watchdog (:func:`~.breaker.watchdog_fetch`), the wedged-vs-slow
+verdict (:func:`~.breaker.classify_stall`), and the
+closed→open→half-open :class:`~.breaker.DeviceBreaker` that degrades
+dispatch to the staged host path while the device is sick.  Its
+``brief()`` rides on ``GET /v1/health`` as the ``device`` block.
 """
 
+from .breaker import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BreakerConfig,
+    DeviceBreaker,
+    DeviceWedgedError,
+    STALL_OK,
+    STALL_SLOW,
+    STALL_WEDGED,
+    classify_stall,
+    watchdog_fetch,
+)
 from .controller import (
     OverloadConfig,
     OverloadController,
@@ -32,6 +52,12 @@ from .slo import (
 )
 
 __all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "BreakerConfig",
+    "DeviceBreaker",
+    "DeviceWedgedError",
     "OverloadConfig",
     "OverloadController",
     "SLOEngine",
@@ -40,12 +66,17 @@ __all__ = [
     "STATE_GATING",
     "STATE_SHEDDING",
     "STATE_STEADY",
+    "STALL_OK",
+    "STALL_SLOW",
+    "STALL_WEDGED",
     "STATUS_BREACHED",
     "STATUS_OK",
     "STATUS_PENDING",
     "TOPIC_HEALTH",
     "TOPIC_SLO",
+    "classify_stall",
     "collect_signals",
     "compute_health",
     "default_slos",
+    "watchdog_fetch",
 ]

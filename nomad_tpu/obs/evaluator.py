@@ -134,6 +134,16 @@ class SLOObservatory:
         report = health_mod.compute_health(
             signals, breached_slos=self.engine.breached(), now=now
         )
+        # The device fault domain rides on the health report so
+        # ``GET /v1/health`` answers "is the TPU path live or degraded"
+        # in the same read as cluster health.  Guarded — a breaker bug
+        # must not stop SLO evaluation.
+        coal = getattr(srv, "coalescer", None)
+        if coal is not None:
+            try:
+                report["device"] = coal.breaker.brief()
+            except Exception:  # noqa: BLE001
+                log.exception("device breaker brief failed")
         events: List[Event] = []
         for spec, old, new in transitions:
             events.append(self._slo_event(spec, old, new, now))

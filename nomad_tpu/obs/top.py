@@ -14,6 +14,7 @@ Layout:
     blocked     : 0            plan queue: 0   applied/s: 511.9
     pipeline    : 3/8 in flight   lane fill: 0.82   stale: 0
     actuator: steady    pressure 0.02/0.01  gate 1.00  429s 0 …
+    device  : closed    trips 0  wedged 0  slow 0  degraded 0  evac 0
     phase                     count      p50 ms      p99 ms
       broker.queue_wait       51234       0.210       1.820
       …
@@ -146,6 +147,16 @@ def render(
             f"  shed {int(shed.get('total_shed', 0))}"
             f"  flips {int(flips.get('total', 0))}"
             f" (supp {int(flips.get('suppressed', 0))})"
+        )
+    dev = h.get("device")
+    if isinstance(dev, dict):
+        lines.append(
+            f"device  : {dev.get('breaker', '?'):<9}"
+            f" trips {int(dev.get('trips', 0))}"
+            f"  wedged {int(dev.get('wedged', 0))}"
+            f"  slow {int(dev.get('slow', 0))}"
+            f"  degraded {int(dev.get('degraded_dispatches', 0))}"
+            f"  evac {int(dev.get('evacuations', 0))}"
         )
     phases = _phase_rows(metrics)
     if phases:

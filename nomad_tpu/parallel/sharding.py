@@ -80,6 +80,21 @@ def make_mesh(
     return Mesh(arr, axis_names=("batch", "node"))
 
 
+def node_shard_count(mesh: Mesh) -> int:
+    """Width of the mesh's node axis — the number of home shards the
+    matrix partitions rows across when this mesh is live.
+
+    This is the number that shrinks on a shard evacuation: the
+    coalescer drops its compiled entry points, rebuilds the mesh over
+    the surviving devices (``make_mesh(survivors)``), and the matrix
+    re-lays-out to this width (``relayout_shards``) so the sharded
+    kernels' ``row_offset = shard * n_local`` arithmetic keeps every
+    row owned by exactly one shard (scheduler/coalescer.py
+    ``evacuate_shard`` / ``heal_shard_evacuations``).
+    """
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape))["node"])
+
+
 def stack_requests(reqs: Sequence[SchedRequest]) -> SchedRequest:
     """Stack B per-eval requests into one batched pytree (leading B axis).
 

@@ -59,6 +59,13 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import trace
+from ..obs.breaker import (
+    STALL_SLOW,
+    STALL_WEDGED,
+    DeviceBreaker,
+    DeviceWedgedError,
+    watchdog_fetch,
+)
 from ..ops import kernels
 from ..ops.encode import RequestSlab, SchedRequest
 from ..retry import env_int
@@ -165,6 +172,9 @@ class _Ticket:
     entries: List[_Pending]
     matrix_version: int
     launched_at: float = 0.0
+    # True when this launch is the half-open breaker's single probe; its
+    # fetch verdict decides whether the device path is re-admitted.
+    canary: bool = False
 
 
 class DeviceCoalescer:
@@ -257,6 +267,19 @@ class DeviceCoalescer:
         # test pins it to the winner-row budget to prove no (N,)-shaped
         # array rides the fetch.
         self.topk_host_bytes_total = 0
+        # Device fault domain (obs/breaker.py): the resolver classifies
+        # every fetch ok/slow/wedged under the watchdog deadline; the
+        # breaker gates _dispatch between the device path and the staged
+        # host twin.  Wedged tickets count here (their futures raise
+        # DeviceWedgedError); shard evacuations re-home the matrix onto
+        # the surviving shards.
+        self.breaker = DeviceBreaker(metrics=metrics)
+        self.wedged_dispatches = 0
+        self.shard_evacuations = 0
+        # Shard count before the first evacuation (heal restores it);
+        # None = no evacuation active.
+        self._pre_evac_shards: Optional[int] = None
+        self._pre_evac_device_shards: Optional[int] = None
         # TSan-lite (lint/tsan.py): lockset checking on the pending queue
         # and device-op list when a test enabled the sanitizer.
         from ..lint.tsan import maybe_instrument
@@ -269,6 +292,10 @@ class DeviceCoalescer:
         if self._thread is not None and self._thread.is_alive():
             return  # leadership can cycle; one dispatch thread only
         self._stop.clear()
+        # A fresh leadership term probes the device fresh — a breaker
+        # left open by the previous term would silently pin the new one
+        # to the degraded path.
+        self.breaker.reset()
         # The pipeline bound: a launch consumes a permit, the resolver
         # returns it after the fetch, so exactly pipeline_depth dispatches
         # overlap (depth 1 = the old serial behavior).  The ticket queue
@@ -395,11 +422,24 @@ class DeviceCoalescer:
                         ctx=p.trace_ctx,
                         metrics=self.metrics,
                     )
+            # Device fault domain: while the breaker is open, dispatches
+            # degrade to the staged host twin (placements keep flowing at
+            # reduced throughput); half-open admits exactly one canary
+            # launch whose fetch verdict decides re-admission.
+            allowed, canary = self.breaker.allow_device_dispatch()
+            if not allowed:
+                self.breaker.note_degraded()
             try:
                 with trace.span("coalescer.launch", lanes=len(batch),
                                 metrics=self.metrics):
-                    packed, version = self._dispatch(batch)
+                    packed, version = self._dispatch(
+                        batch, degraded=not allowed
+                    )
             except BaseException as exc:  # noqa: BLE001
+                if canary:
+                    # The probe died before producing a fetch verdict —
+                    # release the slot so half-open can retry.
+                    self.breaker.cancel_canary()
                 self._depth_sem.release()
                 for p in batch:
                     p.error = exc
@@ -409,7 +449,10 @@ class DeviceCoalescer:
             self.coalesced_requests += len(batch)
             self.inflight += 1
             self._tickets.put(
-                _Ticket(packed, batch, version, launched_at=waited)
+                _Ticket(
+                    packed, batch, version, launched_at=waited,
+                    canary=canary,
+                )
             )
 
     def _shutdown_pipeline(self) -> None:
@@ -428,32 +471,69 @@ class DeviceCoalescer:
             p.done.set()
         self._tickets.put(None)  # sentinel after every real ticket
         self._resolver.join(timeout=10)
+        if self._resolver.is_alive():
+            # The resolver missed its join window (a fetch past every
+            # watchdog bound, or the watchdog disabled): fail whatever is
+            # still queued from here so no caller blocks past shutdown.
+            self._fail_queued_tickets(err)
+
+    def _fail_queued_tickets(self, err: BaseException) -> None:
+        """Drain the ticket queue and fail every undone future — the
+        no-caller-blocks-past-shutdown guarantee.  Pipeline accounting
+        mirrors _resolve_loop's finally block so the dispatch loop never
+        waits on a permit that will not come back."""
+        while True:
+            try:
+                ticket = self._tickets.get_nowait()
+            except queue.Empty:
+                return
+            if ticket is None:
+                continue
+            if ticket.canary:
+                self.breaker.cancel_canary()
+            for p in ticket.entries:
+                if not p.done.is_set():
+                    p.error = err
+                    p.done.set()
+            self.inflight -= 1
+            try:
+                self._depth_sem.release()
+            except ValueError:
+                pass  # bounded; resolver may have already released it
+            with self._cond:
+                self._cond.notify_all()
 
     def _resolve_loop(self) -> None:
         """Resolver (consumer) loop: the ONLY place the live path blocks on
         a device→host fetch.  Tickets complete in launch order."""
-        while True:
-            ticket = self._tickets.get()
-            if ticket is None:
-                return
-            try:
-                self._resolve(ticket)
-            except BaseException as exc:  # noqa: BLE001
-                # _resolve guards the fetch itself; this catches anything
-                # after it (outcome unpack, metrics).  Fail the lanes and
-                # keep the resolver alive — pipeline accounting below must
-                # run no matter what, or the dispatch loop deadlocks on a
-                # permit that will never come back.
-                for p in ticket.entries:
-                    if not p.done.is_set():
-                        p.error = exc
-                        p.done.set()
-            finally:
-                self.inflight -= 1
-                self._depth_sem.release()
-                with self._cond:
-                    # Wake an idle dispatch loop waiting to quiesce.
-                    self._cond.notify_all()
+        try:
+            while True:
+                ticket = self._tickets.get()
+                if ticket is None:
+                    return
+                try:
+                    self._resolve(ticket)
+                except BaseException as exc:  # noqa: BLE001
+                    # _resolve guards the fetch itself; this catches
+                    # anything after it (outcome unpack, metrics).  Fail
+                    # the lanes and keep the resolver alive — pipeline
+                    # accounting below must run no matter what, or the
+                    # dispatch loop deadlocks on a permit that will never
+                    # come back.
+                    for p in ticket.entries:
+                        if not p.done.is_set():
+                            p.error = exc
+                            p.done.set()
+                finally:
+                    self.inflight -= 1
+                    self._depth_sem.release()
+                    with self._cond:
+                        # Wake an idle dispatch loop waiting to quiesce.
+                        self._cond.notify_all()
+        finally:
+            # Resolver exit — clean (sentinel) or death: every in-flight
+            # future must still complete, or its caller blocks forever.
+            self._fail_queued_tickets(RuntimeError("coalescer stopped"))
 
     def _drain_ops(self) -> None:
         while True:
@@ -511,6 +591,7 @@ class DeviceCoalescer:
         if self.n_device_shards > 1 and self._sharded_fn is None:
             from ..parallel.sharding import (
                 make_mesh,
+                node_shard_count,
                 sharded_fused_place_batch,
                 sharded_place_batch,
             )
@@ -519,14 +600,21 @@ class DeviceCoalescer:
             self._sharded_fn = sharded_place_batch(
                 self._mesh, self.scan_length
             )
-            node_shards = int(self._mesh.devices.shape[1])
+            node_shards = node_shard_count(self._mesh)
             if self.megabatch and self.sharded_megabatch:
                 self._sharded_fused_fn = sharded_fused_place_batch(
                     self._mesh, self.scan_length
                 )
             # Home rows to their mesh shard so claims balance across the
             # node axis and growth never migrates a row between shards.
-            if node_shards > 1 and self.matrix.capacity % node_shards == 0:
+            # (Skipped while an evacuation is active: the survivor layout
+            # relayout_shards built IS the homing — re-partitioning here
+            # would undo it.)
+            if (
+                node_shards > 1
+                and self._pre_evac_shards is None
+                and self.matrix.capacity % node_shards == 0
+            ):
                 self.matrix.set_shard_count(node_shards)
                 if self.metrics is not None:
                     # The server registered shard_rows for the init-time
@@ -573,6 +661,68 @@ class DeviceCoalescer:
         self._dark_shards.clear()
         return healed
 
+    def _lose_shard(self) -> None:
+        """Chaos ``shard.loss`` effect (kind 'lost'): evacuate the
+        most-populated home shard — the same deterministic target rule as
+        _darken_shard (highest claimed-row count, lowest index on ties)
+        so seeded schedules replay identically."""
+        if int(getattr(self.matrix, "shard_count", 1)) <= 1:
+            return  # dense layout — nothing to evacuate
+        counts = self.matrix.shard_row_counts()
+        target = max(range(len(counts)), key=lambda s: (counts[s], -s))
+        self.evacuate_shard(target)
+
+    def evacuate_shard(self, shard: int) -> int:
+        """Evacuate a lost shard: the node matrix re-lays-out across the
+        survivors (state/matrix.py ``relayout_shards`` replays the claim
+        policy over nodes in row order, so the result is bit-identical to
+        a from-scratch layout on the surviving shards — the PARITY.md
+        evacuation proof).  In-flight tickets that launched against the
+        old layout invalidate through the matrix version bump + remap
+        window, exactly like growth relocations; the compiled sharded
+        entry points drop so the next dispatch re-resolves against the
+        survivor mesh.  Returns the surviving shard count."""
+        with DEVICE_LOCK:
+            before = int(self.matrix.shard_count)
+            if before <= 1:
+                raise ValueError("evacuation requires shard_count > 1")
+            if self._pre_evac_shards is None:
+                self._pre_evac_shards = before
+                self._pre_evac_device_shards = self.n_device_shards
+            self.matrix.evacuate_shard(shard)
+            survivors = int(self.matrix.shard_count)
+            if self.n_device_shards is not None and self.n_device_shards > 1:
+                self.n_device_shards -= 1
+            self._mesh = None
+            self._sharded_fn = None
+            self._sharded_fused_fn = None
+        self.shard_evacuations += 1
+        self.breaker.note_evacuation()
+        trace.event(
+            "seam.shard.loss.evacuated", shard=shard, survivors=survivors
+        )
+        if self.metrics is not None:
+            self.metrics.incr("nomad.coalescer.shard_evacuations")
+        return survivors
+
+    def heal_shard_evacuations(self) -> Optional[int]:
+        """Re-admit evacuated shards (chaos ``heal``): a full re-layout
+        back to the pre-evacuation shard count, through the same remap
+        mechanism as the evacuation itself.  Returns the restored shard
+        count, or None when no evacuation is active."""
+        restored = self._pre_evac_shards
+        if restored is None:
+            return None
+        with DEVICE_LOCK:
+            self.matrix.relayout_shards(restored)
+            self._pre_evac_shards = None
+            self.n_device_shards = self._pre_evac_device_shards
+            self._mesh = None
+            self._sharded_fn = None
+            self._sharded_fused_fn = None
+        trace.event("seam.shard.loss.healed", restored=restored)
+        return restored
+
     def _ratchet_features(self, k: int):
         """The occupancy-features ratchet: a monotone widening union, so
         each Features variant compiles at most once per process instead of
@@ -614,13 +764,15 @@ class DeviceCoalescer:
             }
         return st
 
-    def _dispatch(self, batch: List[_Pending]):
+    def _dispatch(self, batch: List[_Pending], degraded: bool = False):
         """Launch one batched place_batch; returns (unfetched packed result,
-        matrix version at launch)."""
+        matrix version at launch).  ``degraded`` (breaker open) forces the
+        staged host twin — the fake-device numpy path answers from the
+        host mirror, so placements keep flowing while the device is out."""
         from ..chaos import inject
         from ..ops import fake_device
 
-        fake = fake_device.enabled()
+        fake = fake_device.enabled() or degraded
         if fake:
             n_shards = 1
         else:
@@ -636,6 +788,13 @@ class DeviceCoalescer:
                 version = self.matrix.version
             n = int(self.matrix.capacity)
             arrays = None
+        elif degraded and not fake_device.enabled():
+            # Breaker open on a real backend: feed the host twin from the
+            # host mirror directly — sync() would build a device snapshot
+            # through the very tunnel the breaker just declared wedged.
+            arrays = self.matrix.sync_host()
+            version = self.matrix.version
+            n = int(arrays.used.shape[0])
         else:
             with DEVICE_LOCK:
                 arrays = self.matrix.sync()
@@ -654,6 +813,46 @@ class DeviceCoalescer:
         trace.event("seam.shard.partition", lanes=len(batch))
         if fault is not None and fault.kind == "dark":
             self._darken_shard()
+
+        # Chaos seam: lose an entire matrix shard (mesh-slice death, not
+        # just ineligibility) — kind 'lost' evacuates it: the matrix
+        # re-lays-out across the survivors, in-flight tickets invalidate
+        # through the version/remap stale-dispatch mechanism, and this
+        # launch proceeds against the post-evacuation layout.
+        loss = inject(
+            "shard.loss",
+            shards=int(getattr(self.matrix, "shard_count", 1)),
+            lanes=len(batch),
+        )
+        trace.event("seam.shard.loss", lanes=len(batch))
+        if loss is not None and loss.kind == "lost":
+            self._lose_shard()
+            # The snapshot above was synced pre-evacuation; re-sync so
+            # the launch scores the re-homed layout, not freed rows.
+            if degraded and not fake_device.enabled():
+                arrays = self.matrix.sync_host()
+                version = self.matrix.version
+                n = int(arrays.used.shape[0])
+            elif fake:
+                with DEVICE_LOCK:
+                    arrays = self.matrix.sync()
+                    version = self.matrix.version
+                n = int(arrays.used.shape[0])
+            else:
+                # Evacuation dropped the compiled sharded entry points;
+                # re-resolve so this launch runs on the survivor mesh
+                # (or the single-device path when one shard remains).
+                n_shards = self._resolve_sharding()
+                if n_shards > 1:
+                    with DEVICE_LOCK:
+                        sharded = self.matrix.sync_sharded(self._mesh)
+                        version = self.matrix.version
+                    n = int(self.matrix.capacity)
+                else:
+                    with DEVICE_LOCK:
+                        arrays = self.matrix.sync()
+                        version = self.matrix.version
+                    n = int(arrays.used.shape[0])
 
         if fake:
             # Fake-device backend: numpy twins answer synchronously from
@@ -818,18 +1017,97 @@ class DeviceCoalescer:
         ), version
 
     def _resolve(self, ticket: _Ticket) -> None:
+        from ..chaos import inject
         from ..ops.fake_device import DeferredResult
 
         packed, entries = ticket.packed, ticket.entries
-        try:
-            if isinstance(packed, DeferredResult):
-                packed = packed.result()
-            arr = np.asarray(packed)  # ONE device→host fetch per dispatch
-        except BaseException as exc:  # noqa: BLE001
-            for p in entries:
-                p.error = exc
-                p.done.set()
-            return
+        brk = self.breaker
+
+        # Chaos seams: a synthetic wedge (the fetch never returns inside
+        # the watchdog bound) or a synthetic slowdown (returns inside the
+        # slow band) on this ticket's device→host fetch.
+        wedge = inject("device.wedge", lanes=len(entries))
+        trace.event("seam.device.wedge", lanes=len(entries))
+        slow = None
+        if wedge is None or wedge.kind != "wedge":
+            slow = inject("device.slow", lanes=len(entries))
+        trace.event("seam.device.slow", lanes=len(entries))
+
+        deadline = brk.deadline_s()
+        factor = brk.cfg.wedge_factor
+        seamed = (wedge is not None and wedge.kind == "wedge") or (
+            slow is not None and slow.kind == "slow"
+        )
+
+        if not seamed and isinstance(packed, np.ndarray):
+            # Fast path: the result is already host-resident (fake-device
+            # twin, no synthetic latency) — no fetch to watchdog, and no
+            # sacrificial thread on the 62K evals/s pipeline.
+            arr = packed
+            brk.record_ok(0.0, canary=ticket.canary)
+        else:
+            def _fetch():
+                if wedge is not None and wedge.kind == "wedge":
+                    # Synthetic wedge: hold the fetch past every watchdog
+                    # bound (duration caps it so abandoned threads die).
+                    time.sleep(
+                        wedge.duration
+                        if wedge.duration > 0
+                        else max(deadline * factor * 4.0, 1.0)
+                    )
+                elif slow is not None and slow.kind == "slow":
+                    # Synthetic slow band: past the deadline, inside the
+                    # wedge bound — the result is late but usable.
+                    time.sleep(
+                        slow.duration
+                        if slow.duration > 0
+                        else deadline * (1.0 + factor) / 2.0
+                    )
+                pk = packed
+                if isinstance(pk, DeferredResult):
+                    pk = pk.result()
+                return np.asarray(pk)  # ONE device→host fetch per dispatch
+
+            try:
+                verdict, arr, elapsed = watchdog_fetch(
+                    _fetch, deadline, factor
+                )
+            except BaseException as exc:  # noqa: BLE001
+                if ticket.canary:
+                    brk.cancel_canary()
+                for p in entries:
+                    p.error = exc
+                    p.done.set()
+                return
+            if verdict == STALL_WEDGED:
+                # The fetch blew through the wedge bound: abandon it, trip
+                # the breaker, and complete every lane with the typed
+                # error — the worker's exception path nacks the eval back
+                # to the broker for redelivery (via the degraded path once
+                # the breaker opens).  Later tickets still resolve in
+                # launch order; the pipeline permit is returned by
+                # _resolve_loop's finally.
+                brk.record_wedge(elapsed, canary=ticket.canary)
+                self.wedged_dispatches += 1
+                trace.event(
+                    "coalescer.wedged_dispatch",
+                    lanes=len(entries),
+                    elapsed_ms=round(elapsed * 1e3, 1),
+                )
+                err = DeviceWedgedError(
+                    f"device fetch wedged after {elapsed * 1e3:.0f}ms "
+                    f"(deadline {deadline * 1e3:.0f}ms)",
+                    elapsed_s=elapsed,
+                    deadline_s=deadline,
+                )
+                for p in entries:
+                    p.error = err
+                    p.done.set()
+                return
+            if verdict == STALL_SLOW:
+                brk.record_slow(elapsed, canary=ticket.canary)
+            else:
+                brk.record_ok(elapsed, canary=ticket.canary)
         resolved_at = time.time()
         # Result traffic: the packed (lanes, placements, width) fetch is
         # O(B·P) — winner rows only, never node-axis shaped (lint J005

@@ -375,6 +375,24 @@ class EvalBroker:
                     self._enqueue_ready_locked(nxt)
             self._cond.notify_all()
 
+    def renew(self, eval_id: str, token: str) -> None:
+        """Extend the unack lease of an outstanding delivery by a full
+        nack timeout.  Workers call this around long scheduler
+        invocations (a cold jit compile of the placement kernels can
+        legitimately outlast the nack timeout), so slow-but-alive work no
+        longer races a timeout redelivery — the hazard the generous
+        DEFAULT_NACK_TIMEOUT only papered over.  Raises ValueError on an
+        unknown eval or stale token (the delivery was already settled or
+        redelivered; the worker's plan can no longer commit anyway)."""
+        with self._lock:
+            un = self._unack.get(eval_id)
+            if un is None or un.token != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            un.deadline = time.time() + self.nack_timeout
+            # The watcher naps until the earliest unack deadline; wake it
+            # so the pushed-out deadline recomputes.
+            self._cond.notify_all()
+
     def nack(self, eval_id: str, token: str) -> None:
         """Return an eval for redelivery; past the delivery limit it moves to
         the ``_failed`` queue (eval_broker.go:737)."""

@@ -233,6 +233,12 @@ class Server:
             ),
         )
         m.gauge_fn("nomad.coalescer.stale_dispatches", lambda: c.stale_dispatches)
+        m.gauge_fn(
+            "nomad.coalescer.wedged_dispatches", lambda: c.wedged_dispatches
+        )
+        m.gauge_fn(
+            "nomad.coalescer.shard_evacuations", lambda: c.shard_evacuations
+        )
         m.gauge_fn("nomad.matrix.full_uploads", lambda: mx.full_uploads)
         m.gauge_fn("nomad.matrix.scatter_syncs", lambda: mx.scatter_syncs)
         m.gauge_fn(
@@ -428,6 +434,9 @@ class Server:
         # Release the actuators: a demoted leader must not leave the
         # cluster gated/shedding on stale pressure it can no longer see.
         self.overload_controller.reset()
+        # Same for the device breaker: open/half-open is leader-local
+        # health state; the next leader judges the device fresh.
+        self.coalescer.breaker.reset()
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -439,6 +448,7 @@ class Server:
         self.periodic.stop()
         self.observatory.stop()
         self.overload_controller.reset()
+        self.coalescer.breaker.reset()
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()

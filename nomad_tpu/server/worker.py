@@ -37,6 +37,13 @@ class Worker:
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._renewer: Optional[threading.Thread] = None
+        # (eval_id, token) of the delivery currently inside the scheduler
+        # invocation; the renewer thread extends its unack lease so a
+        # legitimately slow eval (cold jit compile, degraded dispatch)
+        # cannot race a nack-timeout redelivery.
+        self._active_lease: Optional[Tuple[str, str]] = None
+        self.leases_renewed = 0
         self.evals_processed = 0
         self._snapshot: Optional[StateSnapshot] = None
 
@@ -48,11 +55,35 @@ class Worker:
         self._stop.clear()
         self._thread = threading.Thread(target=self.run, name="worker", daemon=True)
         self._thread.start()
+        if self._renewer is None or not self._renewer.is_alive():
+            self._renewer = threading.Thread(
+                target=self._renew_loop, name="worker-renew", daemon=True
+            )
+            self._renewer.start()
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+
+    def _renew_loop(self) -> None:
+        """Lease-renewal heartbeat: while a scheduler invocation is in
+        flight, extend its broker unack lease every third of the nack
+        timeout.  A ValueError means the delivery was already settled or
+        redelivered — nothing to protect; the eval-token check at plan
+        apply is the backstop either way."""
+        while not self._stop.is_set():
+            lease = self._active_lease
+            if lease is not None:
+                try:
+                    self.server.eval_broker.renew(*lease)
+                    self.leases_renewed += 1
+                except ValueError:
+                    pass
+            interval = max(
+                self.server.eval_broker.nack_timeout / 3.0, 0.05
+            )
+            self._stop.wait(interval)
 
     def set_paused(self, paused: bool) -> None:
         if paused:
@@ -113,9 +144,15 @@ class Worker:
             ev.type, self._snapshot, self, self.server.store.matrix
         )
         # invoke_scheduler timer (worker.go:245) — the per-eval hot path.
-        with trace.span("worker.invoke_scheduler", metrics=metrics), \
-                metrics.timer("nomad.worker.invoke_scheduler").time():
-            sched.process(ev)
+        # The renewer thread extends this delivery's unack lease for as
+        # long as the scheduler runs (eval_broker.renew).
+        self._active_lease = (ev.id, token) if token else None
+        try:
+            with trace.span("worker.invoke_scheduler", metrics=metrics), \
+                    metrics.timer("nomad.worker.invoke_scheduler").time():
+                sched.process(ev)
+        finally:
+            self._active_lease = None
         if ev.create_time:
             # Enqueue→scheduled end-to-end latency (eval_broker telemetry).
             metrics.timer("nomad.eval.latency").observe(

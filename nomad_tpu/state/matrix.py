@@ -540,6 +540,85 @@ class NodeMatrix:
             )
         return out.astype(rows.dtype, copy=False)
 
+    def relayout_shards(self, n: int) -> np.ndarray:
+        """Re-home every claimed row under a fresh ``n``-shard partition
+        by replaying the claim policy (least-claimed shard, lowest index
+        on ties, per-shard cursor) over nodes in ascending old-row order.
+
+        That replay is, by construction, bit-identical to inserting the
+        same nodes in that order into an empty ``n``-shard matrix — the
+        PARITY.md shard-evacuation proof.  Capacity is rounded up to the
+        next multiple of ``n`` (so ``_grow``'s divisibility invariant
+        holds); since every claimed node fits the old capacity, the
+        balanced replay always fits the new blocks.
+
+        The old→new mapping (−1 for unclaimed rows) is recorded in the
+        remap window, so in-flight dispatches that scored the old layout
+        translate their winner rows like any growth relocation — rows
+        freed by the re-layout come back −1 (failed placement, retried).
+        Both device mirrors invalidate; the next sync re-uploads in full.
+        Returns the mapping."""
+        n = max(1, int(n))
+        with self._host_lock:
+            old_cap = self.capacity
+            new_cap = old_cap if old_cap % n == 0 else (
+                (old_cap + n - 1) // n
+            ) * n
+            blk = new_cap // n
+            mapping = np.full((old_cap,), -1, np.int32)
+            claimed = [0] * n
+            cursor = [s * blk for s in range(n)]
+            new_row_of: Dict[str, int] = {}
+            for old_row in sorted(self.node_of):
+                s = min(range(n), key=lambda i: (claimed[i], i))
+                r = cursor[s]
+                cursor[s] = r + 1
+                claimed[s] += 1
+                mapping[old_row] = r
+                new_row_of[self.node_of[old_row]] = r
+            new = self._allocate_arrays(new_cap)
+            src = mapping >= 0
+            if src.any():
+                dst = mapping[src]
+                for k, arr in self._alloc.items():
+                    new[k][dst] = arr[src]
+            self._alloc = new
+            self.capacity = new_cap
+            self.shard_count = n
+            self.row_of = new_row_of
+            self.node_of = {r: nid for nid, r in new_row_of.items()}
+            self._free = []
+            self._shard_next = cursor
+            self._shard_claimed = claimed
+            self._next_row = max((r + 1 for r in self.node_of), default=0)
+            self._dirty.clear()
+            self._sharded_dirty.clear()
+            self.version += 1
+            self._remaps.append((self.version, mapping))
+            if len(self._remaps) > self._REMAP_KEEP:
+                dropped = self._remaps[: -self._REMAP_KEEP]
+                self._remap_floor = dropped[-1][0]
+                del self._remaps[: -self._REMAP_KEEP]
+            self._device_valid = False
+            self._sharded_valid = False
+            self._shared_masks = None
+            self._shared_zero_i32 = None
+            return mapping
+
+    def evacuate_shard(self, shard: int) -> np.ndarray:
+        """Evacuate a lost home shard: re-lay every node across the
+        surviving ``shard_count - 1`` shards (the host mirror is
+        authoritative — only the device-resident representation was
+        lost, so no node goes away, every row re-homes).  Returns the
+        old→new row mapping from :meth:`relayout_shards`."""
+        if self.shard_count <= 1:
+            raise ValueError("evacuate_shard requires shard_count > 1")
+        if not 0 <= shard < self.shard_count:
+            raise ValueError(
+                f"shard {shard} out of range 0..{self.shard_count - 1}"
+            )
+        return self.relayout_shards(self.shard_count - 1)
+
     def _claim_row(self, node_id: str) -> int:
         row = self.row_of.get(node_id)
         if row is not None:
@@ -810,6 +889,16 @@ class NodeMatrix:
     def snapshot_host(self) -> Dict[str, np.ndarray]:
         """Host-side view (no copy) of the active arrays."""
         return self._alloc
+
+    def sync_host(self) -> DeviceArrays:
+        """Copy-consistent host snapshot as a :class:`DeviceArrays` of
+        numpy arrays — the degraded dispatch path (device breaker open)
+        feeds the fake-device twin from this without ever touching the
+        device, so a wedged tunnel cannot stall the fallback."""
+        with self._host_lock:
+            return DeviceArrays(
+                **{f: self._alloc[f].copy() for f in DeviceArrays._fields}
+            )
 
     # -- encoded-matrix persistence (bench warm-start) ----------------------
 

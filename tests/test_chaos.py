@@ -581,6 +581,44 @@ class TestScenarios:
         assert report["recovered"], report
         assert report["new_leader_flips"] <= report["flip_budget"], report
 
+    def test_wedged_dispatch_recovers(self, tmp_path):
+        """ISSUE 20 acceptance: one dispatch wedged at pipeline depth 8 —
+        the breaker trips, no future hangs (the crowd drains), the
+        wedged eval is redelivered and placed via the degraded path,
+        the half-open canary re-closes the breaker, and throughput
+        recovers to ≥ 50% of healthy within the scenario window; store
+        invariants green throughout."""
+        report = SCENARIOS["wedged_dispatch_recovers"](11, str(tmp_path))
+        assert report["violations"] == [], report
+        assert report["tripped"], report
+        assert report["wedged_dispatches"] >= 1, report
+        assert report["degraded_dispatches"] >= 1, report
+        assert report["crowd_drained"], report
+        assert report["throughput_ratio"] >= 0.5, report
+        assert report["recovered"], report
+        assert any(k == "wedge" for _, k, _ in report["faults"]), report
+
+    def test_device_slow_flapping(self, tmp_path):
+        """Flapping ``device.slow`` seam: every dispatch still places,
+        and the breaker's flip budget bounds oscillation (no breaker
+        flapping even with a 50% slow rate)."""
+        report = SCENARIOS["device_slow_flapping"](7, str(tmp_path))
+        assert report["violations"] == [], report
+        assert report["flips"] <= report["flip_budget"], report
+        assert any(k == "slow" for _, k, _ in report["faults"]), report
+
+    def test_shard_loss_evacuation_parity(self, tmp_path):
+        """ISSUE 20 acceptance: after evacuating a lost shard the
+        survivor layout is bit-identical to a from-scratch re-layout on
+        the survivors (the PARITY.md evacuation proof), heal restores
+        the original shard count, and the loss→heal round trip leaves
+        placements working and invariants green."""
+        report = SCENARIOS["shard_loss_evacuation"](5, str(tmp_path))
+        assert report["violations"] == [], report
+        assert report["parity_mismatches"] == 0, report
+        assert report["evacuations"] == 1, report
+        assert any(k == "lost" for _, k, _ in report["faults"]), report
+
     def test_partition_schedule_replays_from_seed(self, tmp_path):
         """Same seed → same drop budget and the same fired-fault schedule
         (count-triggered: every fired fault is ("raft.send", "drop"), and

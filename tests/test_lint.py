@@ -861,6 +861,80 @@ class TestO003Actuators:
             "nomad_tpu/obs/controller.py", src) == []
 
 
+class TestO004Breaker:
+    def test_silent_transition_fires(self):
+        fs = obspass.analyze_breaker_transitions("nomad_tpu/m.py", _dedent('''
+            def trip(self):
+                self._apply_transition(2, now)
+        '''))
+        assert len(fs) == 1 and fs[0].rule == "O004", fs
+        assert fs[0].symbol == "trip"
+        assert "_apply_transition" in fs[0].message
+
+    def test_trace_and_counter_is_clean(self):
+        fs = obspass.analyze_breaker_transitions("nomad_tpu/m.py", _dedent('''
+            def trip(self, now):
+                self._apply_transition(2, now)
+                trace.event("seam.breaker.transition", frm="closed", to="open")
+                self.metrics.incr("nomad.breaker.transitions")
+        '''))
+        assert fs == [], fs
+
+    def test_trace_without_counter_fires(self):
+        fs = obspass.analyze_breaker_transitions("nomad_tpu/m.py", _dedent('''
+            def trip(self, now):
+                self._apply_transition(2, now)
+                trace.event("seam.breaker.transition")
+        '''))
+        assert len(fs) == 1, fs
+        assert "counter" in fs[0].message
+        assert "trace" not in fs[0].message.split("never emits")[1]
+
+    def test_counter_without_trace_fires(self):
+        fs = obspass.analyze_breaker_transitions("nomad_tpu/m.py", _dedent('''
+            def trip(self, now):
+                self._apply_transition(2, now)
+                self.metrics.incr("nomad.breaker.transitions")
+        '''))
+        assert len(fs) == 1, fs
+        assert "trace event" in fs[0].message
+
+    def test_mutator_definition_scope_is_skipped(self):
+        # _apply_transition recursing into itself (or a wrapper that IS
+        # the mutator) is not a call site that owes the emission.
+        fs = obspass.analyze_breaker_transitions("nomad_tpu/m.py", _dedent('''
+            class DeviceBreaker:
+                def _apply_transition(self, target, now):
+                    if target == 3:
+                        self._apply_transition(0, now)
+        '''))
+        assert fs == [], fs
+
+    def test_nested_def_does_not_leak(self):
+        fs = obspass.analyze_breaker_transitions("nomad_tpu/m.py", _dedent('''
+            def trip(self, now):
+                self._apply_transition(2, now)
+                def unrelated():
+                    trace.event("seam.breaker.transition")
+                    metrics.incr("nomad.breaker.transitions")
+        '''))
+        assert len(fs) == 1 and fs[0].symbol == "trip", fs
+
+    def test_breaker_module_complies_in_tree(self):
+        # The shipped breaker must stay compliant — every state flip has
+        # a seam event and a counter to line up against placement latency.
+        import os
+
+        from nomad_tpu.lint import repo_root
+
+        with open(os.path.join(
+            repo_root(), "nomad_tpu", "obs", "breaker.py"
+        )) as fh:
+            src = fh.read()
+        assert obspass.analyze_breaker_transitions(
+            "nomad_tpu/obs/breaker.py", src) == []
+
+
 # ----------------------------------------------------------------------
 # Baseline machinery
 # ----------------------------------------------------------------------

@@ -323,6 +323,14 @@ def test_writes_rejected_on_followers_repeated(round_):
         assert _wait(lambda: _leader(agents) is not None, timeout=15)
         leader = _leader(agents)
         followers = [a for a in agents if a is not leader]
+        # The hint comes from each follower's replicator.leader_addr,
+        # which lags the election by one heartbeat — wait until every
+        # follower has actually learned the leader before asserting on
+        # the hint (the historical flake: an empty leader= under load).
+        assert _wait(lambda: all(
+            f.server.replicator.leader_addr == leader.rpc_addr
+            for f in followers
+        ), timeout=15)
         import urllib.request as _rq
 
         for f in followers:

@@ -49,6 +49,13 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from nomad_tpu.obs.breaker import (  # noqa: E402
+    STALL_SLOW,
+    STALL_WEDGED,
+    BreakerConfig,
+    DeviceBreaker,
+    classify_stall,
+)
 from nomad_tpu.retry import (  # noqa: E402
     RetryBudgetExceeded,
     RetryPolicy,
@@ -58,6 +65,10 @@ from nomad_tpu.retry import (  # noqa: E402
 
 EVIDENCE = os.path.join(REPO, "BENCH_tpu_evidence.json")
 PROBE_TIMEOUT = env_int("BENCH_PROBE_TIMEOUT", 150)
+# A probe that answers but takes longer than this is "slow" — the tunnel
+# is alive but degrading, the same verdict band the coalescer's watchdog
+# uses (see nomad_tpu/obs/breaker.py).
+PROBE_SLOW = env_int("BENCH_PROBE_SLOW", 30)
 # The bench itself retries internally; this bound only reaps a run that
 # wedges mid-flight AFTER a healthy probe (observed failure mode: tunnel
 # dies between probe and pipelined phase).
@@ -68,6 +79,28 @@ BENCH_TIMEOUT = env_int("BENCH_WATCH_BENCH_TIMEOUT", 1800)
 # additionally rides into the final evidence entry so wedge frequency is
 # trendable next to the numbers it delayed.
 WEDGED = {"probe": 0, "bench": 0}
+
+# Probe outcomes feed the SAME breaker state machine the coalescer runs
+# on its device fetches — the slow band is [PROBE_SLOW, PROBE_TIMEOUT],
+# a SIGKILLed probe is a wedge.  The breaker's trip count rides into
+# every ledger entry so "the tunnel tripped 3 times overnight" is
+# trendable next to the numbers it delayed.  cold_scale=1: the probe's
+# kill bound already absorbs first-import cost.
+PROBE_BREAKER = DeviceBreaker(config=BreakerConfig(
+    deadline_ms=PROBE_SLOW * 1000,
+    cold_scale=1.0,
+    wedge_factor=max(float(PROBE_TIMEOUT) / max(PROBE_SLOW, 1), 1.0),
+))
+
+
+def _breaker_tallies() -> dict:
+    b = PROBE_BREAKER.brief()
+    return {
+        "probe_breaker": b["breaker"],
+        "probe_breaker_trips": b["trips"],
+        "probe_breaker_wedged": b["wedged"],
+        "probe_breaker_slow": b["slow"],
+    }
 
 
 def _run_reaped(cmd: list, timeout: int, env: dict | None = None):
@@ -98,15 +131,38 @@ def _run_reaped(cmd: list, timeout: int, env: dict | None = None):
 
 def probe() -> str:
     """One disposable-subprocess backend probe; returns the platform name
-    ('tpu', 'cpu', ...) or an error string prefixed with 'err:'."""
+    ('tpu', 'cpu', ...) or an error string prefixed with 'err:'.
+
+    The verdict reuses the coalescer watchdog's wedged-vs-slow
+    classification (:func:`classify_stall`) and feeds ``PROBE_BREAKER``,
+    so the watch and the live dispatch path judge the tunnel with one
+    rulebook: killed-at-timeout is a wedge, answered-late is slow."""
+    t0 = time.monotonic()
     rc, out, err = _run_reaped(
         [sys.executable, "-c",
          "import jax; print(jax.devices()[0].platform)"],
         timeout=PROBE_TIMEOUT,
     )
+    elapsed = time.monotonic() - t0
     if rc is None:
         WEDGED["probe"] += 1
+        PROBE_BREAKER.record_wedge(elapsed)
         return f"err:hung >{PROBE_TIMEOUT}s (wedged tunnel?); killed group"
+    verdict = classify_stall(
+        elapsed, PROBE_BREAKER.deadline_s(), PROBE_BREAKER.cfg.wedge_factor
+    )
+    if verdict == STALL_WEDGED:
+        # The subprocess answered but only past the wedge bound (group
+        # kill raced the reply) — trust the classification, not the rc.
+        PROBE_BREAKER.record_wedge(elapsed)
+    elif verdict == STALL_SLOW:
+        PROBE_BREAKER.record_slow(elapsed)
+        sys.stderr.write(
+            f"bench_watch: probe answered late ({elapsed:.1f}s > "
+            f"{PROBE_SLOW}s) — tunnel degrading\n"
+        )
+    else:
+        PROBE_BREAKER.record_ok(elapsed)
     if rc != 0:
         return f"err:rc={rc}: {err.strip()[-200:]}"
     return out.strip()
@@ -166,13 +222,17 @@ def _record_failure(attempt: int, reason: str) -> None:
     try:
         import bench_history
 
+        tallies = _breaker_tallies()
         bench_history.record_run(
             {
                 "n": attempt,
                 "cmd": "bench_watch probe",
                 "rc": 1,
                 "parsed": None,
-                "tail": reason,
+                "tail": (
+                    f"{reason} [breaker={tallies['probe_breaker']} "
+                    f"trips={tallies['probe_breaker_trips']}]"
+                ),
             },
             source="bench_watch.py",
             **kw,
@@ -192,6 +252,7 @@ def _record_ledger(result: dict) -> None:
     result = dict(result)
     result["probe_wedged"] = WEDGED["probe"]
     result["bench_wedged"] = WEDGED["bench"]
+    result.update(_breaker_tallies())
     try:
         import bench_history
 

@@ -8,13 +8,24 @@
 #   stage 2  lint (jaxpr)   python -m nomad_tpu.lint --jaxpr  — semantic device contracts
 #   stage 3  typecheck      tools/typecheck.sh                — mypy (skips if not installed)
 #   stage 4  tier-1         the ROADMAP.md pytest command     — the real test gate
+#   stage 5  chaos          (--chaos only) the device fault-domain scenarios
+#                           via tools/chaos_repro.py — wedge recovery,
+#                           slow-flap flip budget, shard-loss evacuation
 #
-# Usage: tools/check.sh [--fast]   (--fast skips stage 4)
+# Usage: tools/check.sh [--fast] [--chaos]
+#   --fast   skips stage 4
+#   --chaos  adds stage 5 (seeded device-fault scenario replays)
 set -u
 cd "$(dirname "$0")/.."
 
 FAST=0
-[ "${1:-}" = "--fast" ] && FAST=1
+CHAOS=0
+for arg in "$@"; do
+    case "$arg" in
+        --fast) FAST=1 ;;
+        --chaos) CHAOS=1 ;;
+    esac
+done
 
 names=()
 rcs=()
@@ -41,6 +52,16 @@ if [ "$FAST" -eq 0 ]; then
         python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
+fi
+if [ "$CHAOS" -eq 1 ]; then
+    # The seeded device fault-domain replays (same seeds as tier-1's
+    # TestScenarios — rc 1 on any invariant violation).
+    stage "chaos (wedge)" env JAX_PLATFORMS=cpu \
+        python tools/chaos_repro.py wedged_dispatch_recovers 11
+    stage "chaos (slow-flap)" env JAX_PLATFORMS=cpu \
+        python tools/chaos_repro.py device_slow_flapping 7
+    stage "chaos (shard-loss)" env JAX_PLATFORMS=cpu \
+        python tools/chaos_repro.py shard_loss_evacuation 5
 fi
 
 echo
